@@ -1,0 +1,29 @@
+"""Data plane: columnar DataFrame, feature transformers, sharded batching.
+
+Replaces the reference's Spark DataFrame substrate (SURVEY.md L1): partitions become
+per-chip batch shards; the Spark-ML transformer set (``distkeras/transformers.py``) is
+kept name-for-name.
+"""
+
+from distkeras_tpu.data.dataframe import DataFrame  # noqa: F401
+from distkeras_tpu.data.transformers import (  # noqa: F401
+    DenseTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    OneHotTransformer,
+    ReshapeTransformer,
+    Transformer,
+)
+from distkeras_tpu.data.batching import BatchPlan, make_batches  # noqa: F401
+
+__all__ = [
+    "DataFrame",
+    "Transformer",
+    "LabelIndexTransformer",
+    "OneHotTransformer",
+    "MinMaxTransformer",
+    "ReshapeTransformer",
+    "DenseTransformer",
+    "BatchPlan",
+    "make_batches",
+]

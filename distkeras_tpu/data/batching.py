@@ -1,0 +1,110 @@
+"""Batch planning: DataFrame -> device-shaped minibatch arrays.
+
+This is where the Spark semantics become array semantics. The reference pipeline is
+``df.repartition(num_workers)`` then each executor iterates its partition in
+``batch_size`` minibatches and syncs with the parameter server every
+``communication_window`` steps (``workers.py`` hot loop, SURVEY.md §3.1).
+
+Here the same schedule is planned up front as an **index matrix** — one int32 row id
+per (round, worker, step, sample) — and gathered round-by-round::
+
+    plan.round(r) -> features [num_workers, window, batch_size, ...], labels [...]
+
+One copy of the data lives in host RAM regardless of ``num_epoch`` (the plan stores
+permutations, not copies), so 90-epoch ImageNet plans cost 90 index rows, not 90
+datasets. Round ``r`` = one jitted device program: every worker runs ``window`` local
+steps on its ``[window, batch_size]`` slice, then the collective fold fires.
+Worker-major layout keeps each worker's rows contiguous (the moral equivalent of a
+Spark partition). The leading worker axis is sharded over the ``data`` mesh axis, so
+each chip only ever receives its own slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from distkeras_tpu.data.dataframe import DataFrame
+
+
+@dataclasses.dataclass
+class BatchPlan:
+    x: np.ndarray  # [n, ...feature dims] — single materialized copy
+    y: np.ndarray  # [n, ...label dims]
+    index: np.ndarray  # [rounds, W, K, B] int64 row ids
+    num_workers: int
+    window: int
+    batch_size: int
+    rows_total: int
+
+    @property
+    def num_rounds(self) -> int:
+        return self.index.shape[0]
+
+    @property
+    def rows_used(self) -> int:
+        return int(self.index.size)
+
+    @property
+    def steps_per_worker(self) -> int:
+        return self.num_rounds * self.window
+
+    @property
+    def samples_per_round(self) -> int:
+        return self.num_workers * self.window * self.batch_size
+
+    def round(self, r: int) -> tuple[np.ndarray, np.ndarray]:
+        """Materialize round ``r``: ``[W, K, B, ...]`` feature + label arrays."""
+        idx = self.index[r]
+        return self.x[idx], self.y[idx]
+
+
+def make_batches(
+    df: DataFrame,
+    features_col: str,
+    label_col: str,
+    batch_size: int,
+    num_workers: int,
+    window: int = 1,
+    num_epoch: int = 1,
+    shuffle: bool = False,
+    seed: int = 0,
+) -> BatchPlan:
+    """Lay out ``num_epoch`` passes over ``df`` as fold-round index matrices.
+
+    Rows that don't fill a complete round are dropped (the reference likewise
+    truncates trailing partial minibatches per partition). With ``shuffle`` each
+    epoch gets an independent permutation, so dropped rows differ per epoch.
+    """
+    x = np.asarray(df[features_col])
+    y = np.asarray(df[label_col])
+    n = len(x)
+    per_round = num_workers * window * batch_size
+    if n < per_round:
+        raise ValueError(
+            f"dataset has {n} rows but one fold round needs "
+            f"num_workers*window*batch_size = {per_round}; "
+            "shrink batch_size/communication_window or add data"
+        )
+
+    rng = np.random.default_rng(seed)
+    rounds_per_epoch = n // per_round
+    epochs = []
+    for _ in range(num_epoch):
+        idx = rng.permutation(n) if shuffle else np.arange(n)
+        epochs.append(
+            idx[: rounds_per_epoch * per_round].reshape(
+                rounds_per_epoch, num_workers, window, batch_size
+            )
+        )
+    index = np.concatenate(epochs, axis=0)
+    return BatchPlan(
+        x=x,
+        y=y,
+        index=index,
+        num_workers=num_workers,
+        window=window,
+        batch_size=batch_size,
+        rows_total=n * num_epoch,
+    )

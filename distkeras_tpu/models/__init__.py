@@ -1,0 +1,29 @@
+"""Model zoo + Model abstraction (the framework's "Keras model" analogue)."""
+
+from distkeras_tpu.models.base import (  # noqa: F401
+    DKModule,
+    Model,
+    register_model,
+)
+from distkeras_tpu.models.mlp import MLP, mnist_mlp  # noqa: F401
+from distkeras_tpu.models.cnn import SimpleCNN, mnist_cnn, cifar10_cnn  # noqa: F401
+from distkeras_tpu.models.lstm import LSTMClassifier, imdb_lstm  # noqa: F401
+from distkeras_tpu.models.resnet import ResNet, resnet50  # noqa: F401
+from distkeras_tpu.models.transformer import TransformerLM, small_transformer_lm  # noqa: F401
+
+__all__ = [
+    "DKModule",
+    "Model",
+    "register_model",
+    "MLP",
+    "mnist_mlp",
+    "SimpleCNN",
+    "mnist_cnn",
+    "cifar10_cnn",
+    "LSTMClassifier",
+    "imdb_lstm",
+    "ResNet",
+    "resnet50",
+    "TransformerLM",
+    "small_transformer_lm",
+]

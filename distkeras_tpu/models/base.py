@@ -1,0 +1,119 @@
+"""Model abstraction.
+
+The reference treats "a model" as a compiled Keras object that is serialized with
+``utils.serialize_keras_model`` and re-compiled per worker
+(``workers.py -> Worker.prepare_model``). Here a :class:`Model` is an immutable pair
+(flax module, parameter pytree): pure-functional so a *replica* is just another copy of
+the params — stacking replicas along a mesh axis is a ``jax.tree`` operation, not a
+re-deserialization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from distkeras_tpu.runtime.serialization import (
+    register_model_class,
+    serialize_model,
+)
+
+
+def _coerce(v):
+    # JSON round-trips tuples as lists; flax module fields want tuples back.
+    return tuple(_coerce(x) for x in v) if isinstance(v, list) else v
+
+
+class DKModule(nn.Module):
+    """Base class for zoo modules: adds the config round-trip used by serialization."""
+
+    def get_config(self) -> dict[str, Any]:
+        return {
+            f.name: getattr(self, f.name)
+            for f in dataclasses.fields(self)
+            if f.name not in ("parent", "name")
+        }
+
+    @classmethod
+    def from_config(cls, kwargs: dict[str, Any]) -> "DKModule":
+        return cls(**{k: _coerce(v) for k, v in kwargs.items()})
+
+
+def register_model(cls: type) -> type:
+    """Class decorator: make ``cls`` reconstructible from a serialized spec."""
+    register_model_class(cls.__name__, cls)
+    return cls
+
+
+@dataclasses.dataclass
+class Model:
+    """(module, params) bundle with the serialization surface of a Keras model."""
+
+    module: nn.Module
+    params: Any
+
+    @classmethod
+    def build(
+        cls,
+        module: nn.Module,
+        sample_input: Any,
+        seed: int = 0,
+    ) -> "Model":
+        """Initialize parameters by tracing ``module`` on ``sample_input``.
+
+        ``sample_input`` may be a single array or a tuple of arrays. Shapes only are
+        used (abstract init under ``jax.eval_shape`` would also work, but a concrete
+        init keeps custom modules simple).
+        """
+        inputs = sample_input if isinstance(sample_input, tuple) else (sample_input,)
+        variables = module.init(jax.random.key(seed), *inputs, train=False)
+        params = variables["params"]
+        return cls(module=module, params=params)
+
+    def apply(self, params, *inputs, train: bool = False, rng=None):
+        """Pure forward pass — the jit-safe core of ``model.predict``/``train_on_batch``."""
+        rngs = {"dropout": rng} if rng is not None else None
+        return self.module.apply({"params": params}, *inputs, train=train, rngs=rngs)
+
+    def predict(self, *inputs):
+        return self.apply(self.params, *inputs, train=False)
+
+    def with_params(self, params) -> "Model":
+        return dataclasses.replace(self, params=params)
+
+    def spec(self) -> dict[str, Any]:
+        return {"class": type(self.module).__name__, "kwargs": self.module.get_config()}
+
+    def serialize(self) -> bytes:
+        return serialize_model(self)
+
+    @property
+    def num_params(self) -> int:
+        return sum(int(x.size) for x in jax.tree.leaves(self.params))
+
+    def summary(self) -> str:
+        lines = [f"Model: {type(self.module).__name__}  ({self.num_params:,} params)"]
+        flat = jax.tree_util.tree_flatten_with_path(self.params)[0]
+        for path, leaf in flat:
+            name = "/".join(str(getattr(p, "key", p)) for p in path)
+            lines.append(f"  {name}: {tuple(leaf.shape)} {leaf.dtype}")
+        return "\n".join(lines)
+
+
+def uniform_weights(model: Model, bounds: tuple[float, float] = (-0.5, 0.5), seed: int = 0) -> Model:
+    """Re-init every weight uniformly in ``bounds``.
+
+    Parity: ``distkeras/utils.py -> uniform_weights(model, constraints)``.
+    """
+    lo, hi = bounds
+    leaves, treedef = jax.tree.flatten(model.params)
+    keys = jax.random.split(jax.random.key(seed), len(leaves))
+    new = [
+        jax.random.uniform(k, x.shape, x.dtype, lo, hi) if jnp.issubdtype(x.dtype, jnp.floating) else x
+        for k, x in zip(keys, leaves)
+    ]
+    return model.with_params(jax.tree.unflatten(treedef, new))

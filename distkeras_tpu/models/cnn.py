@@ -1,0 +1,49 @@
+"""Convolutional nets for MNIST / CIFAR-10 (BASELINE configs #2 and #3).
+
+The reference's notebooks build small Keras ``Sequential`` convnets; here a generic
+conv stack. Convs are MXU-tiled by XLA; channel counts are kept multiples of 8 so
+bfloat16 tiles pack cleanly.
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+
+from distkeras_tpu.models.base import DKModule, Model, register_model
+
+
+@register_model
+class SimpleCNN(DKModule):
+    conv_features: tuple = (32, 64)
+    kernel_size: int = 3
+    dense: tuple = (128,)
+    num_outputs: int = 10
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        k = (self.kernel_size, self.kernel_size)
+        for feat in self.conv_features:
+            x = nn.Conv(feat, k, padding="SAME")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for width in self.dense:
+            x = nn.relu(nn.Dense(width)(x))
+            if self.dropout_rate > 0.0:
+                x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_outputs)(x)
+
+
+def mnist_cnn(seed: int = 0) -> Model:
+    import jax.numpy as jnp
+
+    module = SimpleCNN(conv_features=(32, 64), dense=(128,), num_outputs=10)
+    return Model.build(module, jnp.zeros((1, 28, 28, 1), jnp.float32), seed=seed)
+
+
+def cifar10_cnn(seed: int = 0) -> Model:
+    import jax.numpy as jnp
+
+    module = SimpleCNN(conv_features=(64, 128, 256), dense=(256,), num_outputs=10)
+    return Model.build(module, jnp.zeros((1, 32, 32, 3), jnp.float32), seed=seed)

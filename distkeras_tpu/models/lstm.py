@@ -1,0 +1,45 @@
+"""LSTM sentiment classifier — the reference's IMDB workload (BASELINE config #4).
+
+TPU notes: the recurrence is a ``lax.scan`` (via ``nn.RNN``) over static-length
+sequences — no dynamic shapes, so XLA unrolls/pipelines it; the embedding lookup and
+cell matmuls are MXU work.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from distkeras_tpu.models.base import DKModule, Model, register_model
+
+
+@register_model
+class LSTMClassifier(DKModule):
+    vocab_size: int = 20000
+    embed_dim: int = 128
+    hidden_size: int = 128
+    num_outputs: int = 2
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        # tokens: [batch, seq] int32
+        x = nn.Embed(self.vocab_size, self.embed_dim)(tokens)
+        x = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(x)
+        x = x[:, -1, :]  # last hidden state
+        if self.dropout_rate > 0.0:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_outputs)(x)
+
+
+def imdb_lstm(
+    vocab_size: int = 20000,
+    embed_dim: int = 128,
+    hidden_size: int = 128,
+    seq_len: int = 80,
+    seed: int = 0,
+) -> Model:
+    module = LSTMClassifier(
+        vocab_size=vocab_size, embed_dim=embed_dim, hidden_size=hidden_size, num_outputs=2
+    )
+    return Model.build(module, jnp.zeros((1, seq_len), jnp.int32), seed=seed)

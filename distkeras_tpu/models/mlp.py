@@ -1,0 +1,40 @@
+"""MLP — the reference's MNIST-MLP workhorse (BASELINE config #1).
+
+The reference builds this in its example notebooks as a Keras ``Sequential`` of Dense
+layers; here it is a flax module with bfloat16-friendly matmuls (dense layers are MXU
+ops; params stay float32, compute dtype is chosen by the caller's jit context).
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+
+from distkeras_tpu.models.base import DKModule, Model, register_model
+
+_ACTS = {"relu": nn.relu, "tanh": nn.tanh, "gelu": nn.gelu, "sigmoid": nn.sigmoid}
+
+
+@register_model
+class MLP(DKModule):
+    hidden: tuple = (500, 500)
+    num_outputs: int = 10
+    activation: str = "relu"
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        act = _ACTS[self.activation]
+        x = x.reshape((x.shape[0], -1))
+        for width in self.hidden:
+            x = act(nn.Dense(width)(x))
+            if self.dropout_rate > 0.0:
+                x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_outputs)(x)
+
+
+def mnist_mlp(hidden: tuple = (500, 500), num_outputs: int = 10, seed: int = 0) -> Model:
+    """The notebooks' MNIST MLP (784 -> 500 -> 500 -> 10)."""
+    import jax.numpy as jnp
+
+    module = MLP(hidden=hidden, num_outputs=num_outputs)
+    return Model.build(module, jnp.zeros((1, 784), jnp.float32), seed=seed)

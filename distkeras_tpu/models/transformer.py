@@ -1,0 +1,144 @@
+"""Decoder-only transformer LM — the flagship model for multi-axis sharding.
+
+The reference (2016-era MLPs/CNNs/LSTMs) has nothing like this; it exists because the
+rebuild treats long-context + model parallelism as first-class. Design points:
+
+* Pre-LN blocks, GELU MLP, learned positional embeddings; all matmuls MXU-shaped.
+* ``nn.DenseGeneral`` projections named ``query/key/value/out`` so tensor-parallel
+  PartitionSpecs can target the head axis (see ``parallel/sharding.py``).
+* Sequence parallelism: when ``seq_axis`` is set and the module runs inside a
+  ``shard_map`` whose mesh has that axis, activations arrive sequence-sharded
+  ``[B, L/S, D]``. Attention then either all-gathers K/V (``attn_impl='gather'``) or
+  streams K/V blocks around the ring with ``ppermute`` (``attn_impl='ring'``, see
+  ``ops/ring_attention.py``); positions/causal masks are computed from the global
+  offset ``axis_index(seq_axis) * local_len``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from distkeras_tpu.models.base import DKModule, Model, register_model
+
+
+def _global_positions(local_len: int, seq_axis: Optional[str]) -> jax.Array:
+    pos = jnp.arange(local_len)
+    if seq_axis is not None:
+        pos = pos + jax.lax.axis_index(seq_axis) * local_len
+    return pos
+
+
+class CausalSelfAttention(nn.Module):
+    num_heads: int
+    d_model: int
+    seq_axis: Optional[str] = None
+    attn_impl: str = "dense"  # 'dense' | 'gather' | 'ring'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, L, D = x.shape
+        H = self.num_heads
+        Dh = D // H
+        q = nn.DenseGeneral((H, Dh), name="query")(x)
+        k = nn.DenseGeneral((H, Dh), name="key")(x)
+        v = nn.DenseGeneral((H, Dh), name="value")(x)
+        q = q / jnp.sqrt(Dh).astype(q.dtype)
+
+        if self.seq_axis is not None and self.attn_impl == "ring":
+            from distkeras_tpu.ops.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, axis_name=self.seq_axis)
+        else:
+            q_pos = _global_positions(L, self.seq_axis)
+            if self.seq_axis is not None:
+                # 'gather' sequence parallelism: K/V become global, Q stays local.
+                k = jax.lax.all_gather(k, self.seq_axis, axis=1, tiled=True)
+                v = jax.lax.all_gather(v, self.seq_axis, axis=1, tiled=True)
+            k_pos = jnp.arange(k.shape[1])
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None, :, :], scores, jnp.finfo(scores.dtype).min)
+            probs = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return nn.DenseGeneral(D, axis=(-2, -1), name="out")(out)
+
+
+class TransformerBlock(nn.Module):
+    num_heads: int
+    d_model: int
+    d_ff: int
+    dropout_rate: float = 0.0
+    seq_axis: Optional[str] = None
+    attn_impl: str = "dense"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.LayerNorm(name="ln_attn")(x)
+        h = CausalSelfAttention(
+            self.num_heads, self.d_model, seq_axis=self.seq_axis,
+            attn_impl=self.attn_impl, name="attn",
+        )(h, train=train)
+        if self.dropout_rate > 0.0:
+            h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        x = x + h
+        h = nn.LayerNorm(name="ln_mlp")(x)
+        h = nn.Dense(self.d_ff, name="mlp_up")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.d_model, name="mlp_down")(h)
+        if self.dropout_rate > 0.0:
+            h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        return x + h
+
+
+@register_model
+class TransformerLM(DKModule):
+    vocab_size: int = 32000
+    num_layers: int = 4
+    d_model: int = 256
+    num_heads: int = 8
+    d_ff: int = 1024
+    max_seq_len: int = 2048
+    dropout_rate: float = 0.0
+    seq_axis: Optional[str] = None
+    attn_impl: str = "dense"
+    remat: bool = False  # jax.checkpoint each block: trade FLOPs for HBM
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        B, L = tokens.shape
+        x = nn.Embed(self.vocab_size, self.d_model, name="tok_embed")(tokens)
+        pos = _global_positions(L, self.seq_axis)
+        x = x + nn.Embed(self.max_seq_len, self.d_model, name="pos_embed")(pos)[None, :, :]
+        block_cls = TransformerBlock
+        if self.remat:
+            block_cls = nn.remat(TransformerBlock, static_argnums=(2,))
+        for i in range(self.num_layers):
+            x = block_cls(
+                self.num_heads, self.d_model, self.d_ff,
+                dropout_rate=self.dropout_rate, seq_axis=self.seq_axis,
+                attn_impl=self.attn_impl, name=f"block_{i}",
+            )(x, train)
+        x = nn.LayerNorm(name="ln_final")(x)
+        return nn.Dense(self.vocab_size, name="lm_head")(x)
+
+
+def small_transformer_lm(
+    vocab_size: int = 1024,
+    num_layers: int = 2,
+    d_model: int = 128,
+    num_heads: int = 4,
+    d_ff: int = 512,
+    max_seq_len: int = 256,
+    seq_len: int = 64,
+    seed: int = 0,
+    **kwargs,
+) -> Model:
+    module = TransformerLM(
+        vocab_size=vocab_size, num_layers=num_layers, d_model=d_model,
+        num_heads=num_heads, d_ff=d_ff, max_seq_len=max_seq_len, **kwargs,
+    )
+    return Model.build(module, jnp.zeros((1, seq_len), jnp.int32), seed=seed)

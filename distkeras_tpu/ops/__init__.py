@@ -1,0 +1,4 @@
+"""Compute ops: losses, optimizers, collective folds, custom kernels."""
+
+from distkeras_tpu.ops.losses import get_loss  # noqa: F401
+from distkeras_tpu.ops.optimizers import get_optimizer  # noqa: F401

@@ -1,0 +1,24 @@
+"""Collective helpers + shard_map shim.
+
+The reference's entire transport layer is ``distkeras/networking.py`` (length-prefixed
+pickle over TCP, one driver thread per worker). Here the transport is XLA collectives
+over ICI/DCN; this module only smooths API differences across jax versions and offers
+pytree-shaped wrappers.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.7 exports shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def psum_tree(tree, axis_name: str):
+    return jax.lax.psum(tree, axis_name)
+
+
+def pmean_tree(tree, axis_name: str):
+    return jax.lax.pmean(tree, axis_name)

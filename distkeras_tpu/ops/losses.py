@@ -1,0 +1,60 @@
+"""Loss registry.
+
+The reference passes Keras loss *strings* through ``Trainer(loss=...)`` into
+``model.compile(loss=...)`` on each worker (``workers.py -> Worker.prepare_model``).
+Same surface here: trainers accept a string or any callable
+``loss_fn(outputs, labels) -> scalar``. All classification losses take **logits**
+(fusing log-softmax into the loss is both numerically safer and one fewer HBM
+round-trip than Keras's separate softmax activation).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax.numpy as jnp
+import optax
+
+LossFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+def categorical_crossentropy(logits, labels):
+    """One-hot labels [B, C] vs logits [B, C]."""
+    return optax.softmax_cross_entropy(logits, labels).mean()
+
+
+def sparse_categorical_crossentropy(logits, labels):
+    """Integer labels [B] (or [B, L] vs logits [B, L, C] for LM heads)."""
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def binary_crossentropy(logits, labels):
+    return optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+
+
+def mean_squared_error(preds, targets):
+    return jnp.mean(jnp.square(preds - targets))
+
+
+def mean_absolute_error(preds, targets):
+    return jnp.mean(jnp.abs(preds - targets))
+
+
+_LOSSES: dict[str, LossFn] = {
+    "categorical_crossentropy": categorical_crossentropy,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "binary_crossentropy": binary_crossentropy,
+    "mse": mean_squared_error,
+    "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+}
+
+
+def get_loss(loss: Union[str, LossFn]) -> LossFn:
+    if callable(loss):
+        return loss
+    try:
+        return _LOSSES[loss]
+    except KeyError:
+        raise KeyError(f"unknown loss {loss!r}; known: {sorted(_LOSSES)}") from None

@@ -1,0 +1,41 @@
+"""Optimizer registry.
+
+Parity with the reference's ``Trainer(worker_optimizer=...)`` Keras-string surface
+(``'sgd'``, ``'adagrad'``, ``'adam'``...), resolved to optax gradient transformations.
+Any optax ``GradientTransformation`` passes through untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import optax
+
+
+def get_optimizer(
+    optimizer: Union[str, optax.GradientTransformation],
+    learning_rate: float = 0.01,
+    **kwargs,
+) -> optax.GradientTransformation:
+    if isinstance(optimizer, optax.GradientTransformation):
+        return optimizer
+    name = optimizer.lower()
+    if name == "sgd":
+        return optax.sgd(learning_rate, **kwargs)
+    if name == "momentum":
+        return optax.sgd(learning_rate, momentum=kwargs.pop("momentum", 0.9), **kwargs)
+    if name == "nesterov":
+        return optax.sgd(
+            learning_rate, momentum=kwargs.pop("momentum", 0.9), nesterov=True, **kwargs
+        )
+    if name == "adam":
+        return optax.adam(learning_rate, **kwargs)
+    if name == "adamw":
+        return optax.adamw(learning_rate, **kwargs)
+    if name == "adagrad":
+        return optax.adagrad(learning_rate, **kwargs)
+    if name == "rmsprop":
+        return optax.rmsprop(learning_rate, **kwargs)
+    if name == "adadelta":
+        return optax.adadelta(learning_rate, **kwargs)
+    raise KeyError(f"unknown optimizer {optimizer!r}")

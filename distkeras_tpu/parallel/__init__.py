@@ -1,0 +1,19 @@
+"""Parallel engines: the collective replacement for the reference's parameter servers.
+
+* :mod:`disciplines` — the fold rules (DOWNPOUR/ADAG/DynSGD/AEASGD/EAMSGD).
+* :mod:`engine` — window-K local steps + collective fold under ``shard_map``.
+* :mod:`sync` — classic synchronous data parallelism (per-step gradient ``pmean``).
+* :mod:`sharding` — PartitionSpec rules for tensor/sequence parallel meshes.
+"""
+
+from distkeras_tpu.parallel.disciplines import (  # noqa: F401
+    ADAGFold,
+    AEASGDFold,
+    Discipline,
+    DownpourFold,
+    DynSGDFold,
+    EnsembleFold,
+    get_discipline,
+)
+from distkeras_tpu.parallel.engine import AsyncEngine  # noqa: F401
+from distkeras_tpu.parallel.sync import SyncEngine  # noqa: F401

@@ -1,0 +1,180 @@
+"""Optimization-discipline folds: the parameter server, re-derived for collectives.
+
+The reference implements each discipline twice — a worker half
+(``distkeras/workers.py``: what to *commit*) and a server half
+(``distkeras/parameter_servers.py``: how to *fold* a commit into the center
+variable). On TPU both halves collapse into one pure function executed identically on
+every chip inside ``shard_map``: given this replica's local params after
+``communication_window`` local steps and the (replicated) center variable, produce the
+new center — via ``psum`` over the ``data`` axis — and this replica's post-fold params.
+
+Async-to-deterministic mapping (SURVEY.md §7): one "fold round" = every worker pulls
+the center, runs K local steps, and commits once. Commits within a round are modeled
+as serialized in worker order, which makes staleness *explicit*: worker ``i``'s commit
+lands after ``i`` fresher commits. The reference's nondeterministic race becomes a
+reproducible schedule with the same aggregate semantics (sum of commits folded per
+discipline rule).
+
+Discipline semantics (reference anchors in each class docstring):
+
+=========  ====================================================================
+DOWNPOUR   commit Δ = w_local − w_pulled; server: center += Δ
+ADAG       commit Δ/K (accumulated-gradient normalization); server: center += Δ/K
+DynSGD     commit Δ; server: center += Δ · 1/(staleness+1)
+AEASGD     commit e = ρ·(w_local − center); worker: w −= e; server: center += e
+EAMSGD     AEASGD fold + momentum in the worker's local optimizer
+=========  ====================================================================
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class FoldResult(NamedTuple):
+    center: Any
+    local: Any
+    fold_state: Any
+
+
+def _tree_add(a, b):
+    return jax.tree.map(jnp.add, a, b)
+
+
+def _tree_sub(a, b):
+    return jax.tree.map(jnp.subtract, a, b)
+
+
+def _tree_scale(tree, s):
+    return jax.tree.map(lambda x: x * s, tree)
+
+
+class Discipline:
+    """Base fold rule. Subclasses run *inside* shard_map over ``axis_name``."""
+
+    #: pull-based disciplines start every round from the center variable; elastic
+    #: ones keep a persistent local replica.
+    pulls_center: bool = True
+
+    def init_state(self, params) -> Any:
+        return ()
+
+    def fold(self, center, local, fold_state, *, axis_name: str, window: int,
+             num_workers: int) -> FoldResult:
+        raise NotImplementedError
+
+
+class DownpourFold(Discipline):
+    """DOWNPOUR (Dean et al.; reference ``DOWNPOURWorker`` +
+    ``DeltaParameterServer.handle_commit: center += delta``).
+
+    Every worker's accumulated local update is summed into the center — the aggregate
+    effect of all async commits in one round.
+    """
+
+    def fold(self, center, local, fold_state, *, axis_name, window, num_workers):
+        delta = _tree_sub(local, center)
+        total = lax.psum(delta, axis_name)
+        new_center = _tree_add(center, total)
+        return FoldResult(new_center, new_center, fold_state)
+
+
+class ADAGFold(Discipline):
+    """ADAG — asynchronous distributed adaptive gradients via accumulated-gradient
+    normalization (Hermans; reference ``ADAGWorker`` + ``ADAGParameterServer``).
+
+    The commit is the window-accumulated update **normalized by the number of local
+    steps**, turning K small steps into one averaged step direction; this is what
+    keeps the center stable as workers (and therefore commit rate) scale.
+    """
+
+    def fold(self, center, local, fold_state, *, axis_name, window, num_workers):
+        delta = _tree_scale(_tree_sub(local, center), 1.0 / float(window))
+        total = lax.psum(delta, axis_name)
+        new_center = _tree_add(center, total)
+        return FoldResult(new_center, new_center, fold_state)
+
+
+class DynSGDFold(Discipline):
+    """DynSGD (reference ``DynSGDWorker`` + ``DynSGDParameterServer``): fold each
+    commit scaled by ``1/(staleness+1)``, staleness = number of center updates between
+    the worker's pull and its commit.
+
+    Deterministic schedule: commits serialize in worker order within a round, so
+    worker ``i`` has staleness ``i`` — exactly the reference's counter semantics
+    (server update-counter minus the worker's last-pull counter) under the serialized
+    ordering.
+    """
+
+    def fold(self, center, local, fold_state, *, axis_name, window, num_workers):
+        staleness = lax.axis_index(axis_name).astype(jnp.float32)
+        scale = 1.0 / (staleness + 1.0)
+        delta = _tree_scale(_tree_sub(local, center), scale)
+        total = lax.psum(delta, axis_name)
+        new_center = _tree_add(center, total)
+        return FoldResult(new_center, new_center, fold_state)
+
+
+class AEASGDFold(Discipline):
+    """Asynchronous elastic averaging SGD (Zhang et al.; reference ``AEASGDWorker`` +
+    ``DeltaParameterServer``).
+
+    The worker computes the elastic difference ``e = α·(w − center)`` with
+    ``α = ρ·learning_rate`` (the reference's elasticity scaling — ρ alone would make
+    the local/center gap *grow* each round for ρ > 1 and diverge), moves *itself*
+    toward the center (``w −= e``) and the center toward itself (``center += e``).
+    Locals persist across rounds — exploration is the point.
+    """
+
+    pulls_center = False
+
+    def __init__(self, alpha: float = 0.05):
+        if not (0.0 < alpha < 1.0):
+            raise ValueError(
+                f"elastic rate alpha={alpha} must be in (0, 1); alpha = rho * "
+                "learning_rate (alpha >= 1 makes |local - center| grow every round)"
+            )
+        self.alpha = alpha
+
+    def fold(self, center, local, fold_state, *, axis_name, window, num_workers):
+        elastic = _tree_scale(_tree_sub(local, center), self.alpha)
+        new_local = _tree_sub(local, elastic)
+        new_center = _tree_add(center, lax.psum(elastic, axis_name))
+        return FoldResult(new_center, new_local, fold_state)
+
+
+class EAMSGDFold(AEASGDFold):
+    """EAMSGD: the momentum variant of AEASGD (reference ``EAMSGDWorker``). The fold
+    is identical; the momentum lives in the worker's local optimizer, which the
+    trainer configures (``momentum`` kwarg). Same ``α = ρ·learning_rate`` scaling."""
+
+
+class EnsembleFold(Discipline):
+    """No communication at all: workers train independently
+    (reference ``EnsembleTrainer`` / the per-worker phase of ``AveragingTrainer``)."""
+
+    pulls_center = False
+
+    def fold(self, center, local, fold_state, *, axis_name, window, num_workers):
+        return FoldResult(center, local, fold_state)
+
+
+_DISCIPLINES = {
+    "downpour": DownpourFold,
+    "adag": ADAGFold,
+    "dynsgd": DynSGDFold,
+    "aeasgd": AEASGDFold,
+    "eamsgd": EAMSGDFold,
+    "ensemble": EnsembleFold,
+}
+
+
+def get_discipline(name: str, **kwargs) -> Discipline:
+    try:
+        return _DISCIPLINES[name.lower()](**kwargs)
+    except KeyError:
+        raise KeyError(f"unknown discipline {name!r}; known: {sorted(_DISCIPLINES)}") from None

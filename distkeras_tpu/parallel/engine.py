@@ -1,0 +1,176 @@
+"""The async-discipline engine: K local steps per replica + one collective fold.
+
+This is the TPU replacement for the reference's *entire* L2–L4 stack (SURVEY.md §1):
+socket transport, parameter-server thread, and executor worker loop become one
+``shard_map``-wrapped, jit-compiled "fold round"::
+
+    round(center, locals, opt_state, batch[W, K, B, ...]):
+        per replica: K minibatch steps via lax.scan     (workers.py)
+        fold: psum of per-replica deltas into center    (disciplines.py)
+
+State layout on the mesh (axis ``data`` = one reference "worker" per slice):
+
+* ``center``    — replicated (the parameter server's center variable)
+* ``locals_``   — stacked ``[W, ...]``, sharded on the worker axis
+* ``opt_state`` — stacked ``[W, ...]``, sharded likewise (each reference worker
+  compiled its *own* optimizer — per-replica optimizer state is parity, not a bug)
+
+The per-round batch arrives sharded the same way, so no sample ever leaves its chip;
+the only cross-chip traffic is the O(model) psum per round — exactly the traffic the
+reference pushed through pickle/TCP per commit, now on ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.data.batching import BatchPlan
+from distkeras_tpu.ops.collectives import shard_map
+from distkeras_tpu.ops.losses import get_loss
+from distkeras_tpu.ops.optimizers import get_optimizer
+from distkeras_tpu.parallel.disciplines import Discipline
+from distkeras_tpu.runtime.mesh import DATA_AXIS
+from distkeras_tpu.workers import make_local_loop
+
+
+class EngineState(NamedTuple):
+    center: Any
+    locals_: Any
+    opt_state: Any
+    fold_state: Any
+    rng: jax.Array
+
+
+def _stack_for_workers(tree, num_workers: int):
+    return jax.tree.map(lambda a: jnp.broadcast_to(a, (num_workers,) + a.shape), tree)
+
+
+class AsyncEngine:
+    """Runs a :class:`Discipline` over a 1-D ``data`` mesh."""
+
+    def __init__(
+        self,
+        model,
+        optimizer,
+        loss,
+        discipline: Discipline,
+        mesh: Mesh,
+        window: int,
+        learning_rate: float = 0.01,
+        compute_dtype=None,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.mesh = mesh
+        self.discipline = discipline
+        self.window = window
+        self.num_workers = mesh.shape[DATA_AXIS]
+        self.seed = seed
+        self.tx = get_optimizer(optimizer, learning_rate)
+        self.loss_fn = get_loss(loss)
+        self._local_loop = make_local_loop(
+            model.module, self.loss_fn, self.tx, compute_dtype=compute_dtype
+        )
+        self._round_fn = self._build_round_fn()
+
+    # ------------------------------------------------------------------
+    def _build_round_fn(self):
+        disc = self.discipline
+        window = self.window
+        num_workers = self.num_workers
+        local_loop = self._local_loop
+
+        def body(center, locals_, opt_state, fold_state, rng, xs, ys):
+            # Inside shard_map: leading worker axis is 1 on this slice.
+            local = jax.tree.map(lambda a: jnp.squeeze(a, 0), locals_)
+            opt = jax.tree.map(lambda a: jnp.squeeze(a, 0), opt_state)
+            xs0, ys0 = xs[0], ys[0]  # [K, B, ...]
+
+            start = center if disc.pulls_center else local
+            worker_rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
+            new_local, new_opt, losses = local_loop(start, opt, xs0, ys0, worker_rng)
+
+            new_center, new_local, new_fold_state = disc.fold(
+                center, new_local, fold_state,
+                axis_name=DATA_AXIS, window=window, num_workers=num_workers,
+            )
+            loss = jax.lax.pmean(jnp.mean(losses), DATA_AXIS)
+            next_rng = jax.random.split(rng, 1)[0]
+            return (
+                new_center,
+                jax.tree.map(lambda a: a[None], new_local),
+                jax.tree.map(lambda a: a[None], new_opt),
+                new_fold_state,
+                next_rng,
+                loss,
+            )
+
+        mapped = shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),
+            check_vma=False,
+        )
+
+        def round_fn(state: EngineState, xs, ys):
+            center, locals_, opt_state, fold_state, rng, loss = mapped(
+                state.center, state.locals_, state.opt_state, state.fold_state,
+                state.rng, xs, ys,
+            )
+            return EngineState(center, locals_, opt_state, fold_state, rng), loss
+
+        return jax.jit(round_fn, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------
+    def init_state(self) -> EngineState:
+        W = self.num_workers
+        # Deep-copy: round_fn donates its input state, and device_put may alias the
+        # model's own buffers — donation must never delete the user's Model.
+        center = jax.tree.map(lambda a: np.array(a), self.model.params)
+        locals_ = _stack_for_workers(center, W)
+        opt_state = _stack_for_workers(self.tx.init(center), W)
+        fold_state = self.discipline.init_state(center)
+        rng = jax.random.key(self.seed)
+
+        rep = NamedSharding(self.mesh, P())
+        shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        return EngineState(
+            center=jax.device_put(center, rep),
+            locals_=jax.device_put(locals_, shard),
+            opt_state=jax.device_put(opt_state, shard),
+            fold_state=jax.device_put(fold_state, rep),
+            rng=jax.device_put(rng, rep),
+        )
+
+    def _put_batch(self, xs: np.ndarray, ys: np.ndarray):
+        shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        return jax.device_put(xs, shard), jax.device_put(ys, shard)
+
+    def run(
+        self,
+        plan: BatchPlan,
+        state: Optional[EngineState] = None,
+        on_round: Optional[Callable[[int, float], None]] = None,
+    ):
+        """Execute every fold round in ``plan``. Returns (state, losses [num_rounds])."""
+        if plan.num_workers != self.num_workers:
+            raise ValueError(
+                f"plan built for {plan.num_workers} workers, mesh has {self.num_workers}"
+            )
+        if state is None:
+            state = self.init_state()
+        losses = []
+        for r in range(plan.num_rounds):
+            xs, ys = self._put_batch(*plan.round(r))
+            state, loss = self._round_fn(state, xs, ys)
+            losses.append(loss)
+            if on_round is not None:
+                on_round(r, loss)
+        return state, np.asarray([float(l) for l in losses])

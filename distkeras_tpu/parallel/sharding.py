@@ -1,0 +1,59 @@
+"""PartitionSpec rules: mapping parameter pytrees onto multi-axis meshes.
+
+The reference has no model parallelism (SURVEY.md §2 parallelism inventory) — this is
+new surface for the TPU rebuild. Rules are (regex over the param path, PartitionSpec)
+pairs; first match wins, default replicated. The transformer rules implement standard
+Megatron-style tensor parallelism: attention heads and MLP hidden dim sharded over
+``model``, with XLA/GSPMD inserting the all-reduces at ``out``/``mlp_down``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.runtime.mesh import MODEL_AXIS
+
+# (path regex, spec). Paths are '/'-joined flax param paths, e.g.
+# "block_0/attn/query/kernel".
+TRANSFORMER_TP_RULES: list[tuple[str, P]] = [
+    (r".*/attn/(query|key|value)/kernel$", P(None, MODEL_AXIS, None)),
+    (r".*/attn/(query|key|value)/bias$", P(MODEL_AXIS, None)),
+    (r".*/attn/out/kernel$", P(MODEL_AXIS, None, None)),
+    (r".*/mlp_up/kernel$", P(None, MODEL_AXIS)),
+    (r".*/mlp_up/bias$", P(MODEL_AXIS)),
+    (r".*/mlp_down/kernel$", P(MODEL_AXIS, None)),
+    (r"tok_embed/embedding$", P(None, MODEL_AXIS)),
+    (r"pos_embed/embedding$", P(None, MODEL_AXIS)),
+    (r"lm_head/kernel$", P(None, MODEL_AXIS)),
+    (r"lm_head/bias$", P(MODEL_AXIS)),
+]
+
+
+def param_path_specs(params, rules: Sequence[tuple[str, P]]):
+    """Pytree of PartitionSpecs: first rule whose regex matches the param path."""
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(path, leaf):
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        for pat, spec in compiled:
+            if pat.search(name):
+                if len(spec) > leaf.ndim:
+                    raise ValueError(
+                        f"rule {pat.pattern!r} spec {spec} has more axes than "
+                        f"param {name} (shape {leaf.shape})"
+                    )
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def param_shardings(params, mesh: Mesh, rules: Sequence[tuple[str, P]]):
+    """Pytree of NamedShardings for ``params`` on ``mesh`` under ``rules``."""
+    specs = param_path_specs(params, rules)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                        specs, is_leaf=lambda x: isinstance(x, P))

@@ -1,0 +1,127 @@
+"""Synchronous data parallelism: per-step gradient ``pmean``.
+
+This is the reference's ``SynchronousDistributedTrainer`` path (and the "synchronous
+DOWNPOUR" of BASELINE config #5), built the canonical TPU way: one replicated set of
+params, batch sharded over the ``data`` axis, gradients all-reduced every step. No
+center-variable bookkeeping — replicas never diverge, so the state is just
+(params, opt_state) and the collective is a single fused psum riding ICI.
+
+``window`` here means *steps per jitted program* (the scan length): folding many steps
+into one XLA program amortizes dispatch overhead exactly like the async engine's
+communication window, but with zero semantic effect.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.data.batching import BatchPlan
+from distkeras_tpu.ops.collectives import shard_map
+from distkeras_tpu.ops.losses import get_loss
+from distkeras_tpu.ops.optimizers import get_optimizer
+from distkeras_tpu.runtime.mesh import DATA_AXIS
+from distkeras_tpu.workers import make_local_loop
+
+
+class SyncState(NamedTuple):
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+
+
+class SyncEngine:
+    def __init__(
+        self,
+        model,
+        optimizer,
+        loss,
+        mesh: Mesh,
+        learning_rate: float = 0.01,
+        compute_dtype=None,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.mesh = mesh
+        self.num_workers = mesh.shape[DATA_AXIS]
+        self.seed = seed
+        self.tx = get_optimizer(optimizer, learning_rate)
+        self.loss_fn = get_loss(loss)
+        self.compute_dtype = compute_dtype
+        self._round_fn = self._build_round_fn()
+
+    def _build_round_fn(self):
+        def sync_grads(grads, loss):
+            # The one collective: mean gradient across chips, fused by XLA.
+            return lax.pmean(grads, DATA_AXIS), lax.pmean(loss, DATA_AXIS)
+
+        local_loop = make_local_loop(
+            self.model.module, self.loss_fn, self.tx,
+            compute_dtype=self.compute_dtype, grad_transform=sync_grads,
+        )
+
+        def body(params, opt_state, rng, xs, ys):
+            # xs: [1, K, B/W, ...] on this slice — same worker-major layout as the
+            # async engine, so one BatchPlan serves both engines.
+            xs0, ys0 = xs[0], ys[0]
+            # Per-replica dropout stream; the *carried* rng stays replicated (the
+            # divergent key never leaves the local loop).
+            step_rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
+            params, opt_state, losses = local_loop(params, opt_state, xs0, ys0, step_rng)
+            next_rng = jax.random.split(rng, 1)[0]
+            return params, opt_state, next_rng, losses
+
+        mapped = shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(), P(), P(), P()),
+            check_vma=False,
+        )
+
+        def round_fn(state: SyncState, xs, ys):
+            params, opt_state, rng, losses = mapped(
+                state.params, state.opt_state, state.rng, xs, ys
+            )
+            return SyncState(params, opt_state, rng), jnp.mean(losses)
+
+        return jax.jit(round_fn, donate_argnums=(0,))
+
+    def init_state(self) -> SyncState:
+        rep = NamedSharding(self.mesh, P())
+        # Deep-copy: round_fn donates its input state; never alias the user's Model.
+        params = jax.tree.map(lambda a: np.array(a), self.model.params)
+        return SyncState(
+            params=jax.device_put(params, rep),
+            opt_state=jax.device_put(self.tx.init(params), rep),
+            rng=jax.device_put(jax.random.key(self.seed), rep),
+        )
+
+    def run(
+        self,
+        plan: BatchPlan,
+        state: Optional[SyncState] = None,
+        on_round: Optional[Callable[[int, float], None]] = None,
+    ):
+        if plan.num_workers != self.num_workers:
+            raise ValueError(
+                f"plan built for {plan.num_workers} workers, mesh has {self.num_workers}"
+            )
+        if state is None:
+            state = self.init_state()
+        shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        losses = []
+        for r in range(plan.num_rounds):
+            fx, fy = plan.round(r)
+            xs = jax.device_put(fx, shard)
+            ys = jax.device_put(fy, shard)
+            state, loss = self._round_fn(state, xs, ys)
+            losses.append(loss)
+            if on_round is not None:
+                on_round(r, loss)
+        return state, np.asarray([float(l) for l in losses])

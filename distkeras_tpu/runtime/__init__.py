@@ -1,0 +1,1 @@
+"""Core runtime: device-mesh bootstrap, serialization, configuration."""

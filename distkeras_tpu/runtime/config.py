@@ -1,0 +1,37 @@
+"""Run-level configuration.
+
+The reference keeps every hyperparameter as a trainer ``__init__`` kwarg
+(``distkeras/trainers.py``: ``num_workers``, ``batch_size``, ``num_epoch``,
+``communication_window``, ``learning_rate``, ``master_port``...). We keep that
+kwargs-first surface on the trainers and normalize into this dataclass internally, so
+jitted code sees one hashable config object.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    batch_size: int = 32
+    num_epoch: int = 1
+    communication_window: int = 5
+    learning_rate: float = 0.01
+    num_workers: Optional[int] = None  # None -> all devices
+    compute_dtype: str = "bfloat16"  # MXU-native; params stay float32
+    seed: int = 0
+    shuffle: bool = False
+    drop_remainder: bool = True
+
+    @property
+    def dtype(self):
+        return _DTYPES[self.compute_dtype]
+
+    def replace(self, **kw) -> "RunConfig":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,78 @@
+"""Model and parameter serialization.
+
+Parity with the reference's ``distkeras/utils.py -> serialize_keras_model /
+deserialize_keras_model``, which turned a Keras model into
+``{'model': model.to_json(), 'weights': model.get_weights()}`` so it could be pickled
+onto Spark executors. Here a model is a registered flax module class + JSON-able
+constructor kwargs + a parameter pytree; the wire format is::
+
+    MAGIC | u32 spec_len | spec JSON (class, kwargs, version) | flax msgpack params
+
+No pickle anywhere — the payload is msgpack via ``flax.serialization``, safe to load
+from untrusted storage, and the spec is plain JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any
+
+from flax import serialization as flax_ser
+
+MAGIC = b"DKTPU1"
+
+# Registry of model classes usable in serialized specs; populated by
+# distkeras_tpu.models.base.register_model.
+MODEL_REGISTRY: dict[str, type] = {}
+
+
+def register_model_class(name: str, cls: type) -> None:
+    MODEL_REGISTRY[name] = cls
+
+
+def get_model_class(name: str) -> type:
+    try:
+        return MODEL_REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown model class {name!r}; known: {sorted(MODEL_REGISTRY)}. "
+            "Custom modules must be registered with "
+            "distkeras_tpu.models.register_model before deserialization."
+        ) from None
+
+
+def serialize_params(params: Any) -> bytes:
+    """Parameter pytree -> msgpack bytes (weights-only path)."""
+    return flax_ser.to_bytes(params)
+
+
+def deserialize_params(target: Any, data: bytes) -> Any:
+    """msgpack bytes -> pytree with ``target``'s structure."""
+    return flax_ser.from_bytes(target, data)
+
+
+def serialize_model(model) -> bytes:
+    """A ``Model`` -> self-describing bytes (architecture spec + weights)."""
+    spec = dict(model.spec())
+    spec["format_version"] = 1
+    spec_bytes = json.dumps(spec).encode("utf-8")
+    payload = flax_ser.to_bytes(model.params)
+    return MAGIC + struct.pack("<I", len(spec_bytes)) + spec_bytes + payload
+
+
+def deserialize_model(data: bytes):
+    """Bytes from :func:`serialize_model` -> reconstructed ``Model``."""
+    from distkeras_tpu.models.base import Model  # local import: avoid cycle
+
+    if data[: len(MAGIC)] != MAGIC:
+        raise ValueError("not a distkeras_tpu serialized model (bad magic)")
+    off = len(MAGIC)
+    (spec_len,) = struct.unpack_from("<I", data, off)
+    off += 4
+    spec = json.loads(data[off : off + spec_len].decode("utf-8"))
+    off += spec_len
+    cls = get_model_class(spec["class"])
+    module = cls.from_config(spec["kwargs"])
+    params = flax_ser.msgpack_restore(data[off:])
+    return Model(module=module, params=params)

@@ -1,0 +1,319 @@
+"""Trainer taxonomy — class-for-class parity with ``distkeras/trainers.py``.
+
+Same names, same constructor-kwargs surface, same ``train(dataframe) -> model`` entry
+point (SURVEY.md §2, L5). What changed underneath: ``num_workers`` Spark partitions
+become ``num_workers`` chips on a ``data`` mesh; the parameter-server thread becomes a
+collective fold (``parallel/disciplines.py``); ``model.train_on_batch`` becomes a
+jitted ``lax.scan`` window (``workers.py``).
+
+Trainer -> engine mapping:
+
+* ``SingleTrainer``                  -> SyncEngine on a 1-chip mesh
+* ``SynchronousDistributedTrainer``  -> SyncEngine (per-step gradient pmean)
+* ``DOWNPOUR/ADAG/DynSGD``           -> AsyncEngine, pull-based folds
+* ``AEASGD/EAMSGD``                  -> AsyncEngine, elastic folds
+* ``AveragingTrainer``               -> AsyncEngine, no-comm fold + final weight mean
+* ``EnsembleTrainer``                -> AsyncEngine, no-comm fold, returns N models
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.data.batching import make_batches
+from distkeras_tpu.data.dataframe import DataFrame
+from distkeras_tpu.models.base import Model
+from distkeras_tpu.parallel.disciplines import (
+    ADAGFold,
+    AEASGDFold,
+    Discipline,
+    DownpourFold,
+    DynSGDFold,
+    EAMSGDFold,
+    EnsembleFold,
+)
+from distkeras_tpu.parallel.engine import AsyncEngine
+from distkeras_tpu.parallel.sync import SyncEngine
+from distkeras_tpu.runtime.mesh import data_mesh
+
+_DTYPES = {None: None, "float32": jnp.float32, "bfloat16": jnp.bfloat16}
+
+
+class Trainer:
+    """Base trainer (reference ``Trainer``): owns model, optimizer, loss, timing.
+
+    ``worker_optimizer`` and ``loss`` accept the reference's Keras-style strings or
+    any optax transformation / callable.
+    """
+
+    def __init__(
+        self,
+        model: Model,
+        worker_optimizer="sgd",
+        loss="categorical_crossentropy",
+        features_col: str = "features",
+        label_col: str = "label",
+        batch_size: int = 32,
+        num_epoch: int = 1,
+        learning_rate: float = 0.01,
+        compute_dtype: Optional[str] = None,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.worker_optimizer = worker_optimizer
+        self.loss = loss
+        self.features_col = features_col
+        self.label_col = label_col
+        self.batch_size = batch_size
+        self.num_epoch = num_epoch
+        self.learning_rate = learning_rate
+        self.compute_dtype = _DTYPES[compute_dtype] if isinstance(compute_dtype, (str, type(None))) else compute_dtype
+        self.seed = seed
+        self.history: np.ndarray | None = None
+        self.training_time: float = 0.0
+        self._t_start: float | None = None
+
+    # -- timing parity (reference Trainer.record_training_start/stop) -------
+    def record_training_start(self):
+        self._t_start = time.perf_counter()
+
+    def record_training_stop(self):
+        self.training_time = time.perf_counter() - self._t_start
+
+    def get_training_time(self) -> float:
+        return self.training_time
+
+    def get_history(self) -> np.ndarray:
+        return self.history
+
+    def train(self, dataframe: DataFrame, shuffle: bool = False) -> Model:
+        raise NotImplementedError
+
+
+class SingleTrainer(Trainer):
+    """One-replica baseline (reference ``SingleTrainer``): coalesce to a single
+    worker, plain minibatch SGD, no communication."""
+
+    def __init__(self, *args, steps_per_program: int = 8, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.steps_per_program = steps_per_program
+
+    def train(self, dataframe: DataFrame, shuffle: bool = False) -> Model:
+        self.record_training_start()
+        mesh = data_mesh(num_workers=1)
+        engine = SyncEngine(
+            self.model, self.worker_optimizer, self.loss, mesh,
+            learning_rate=self.learning_rate, compute_dtype=self.compute_dtype,
+            seed=self.seed,
+        )
+        plan = make_batches(
+            dataframe, self.features_col, self.label_col, self.batch_size,
+            num_workers=1, window=self.steps_per_program, num_epoch=self.num_epoch,
+            shuffle=shuffle, seed=self.seed,
+        )
+        state, losses = engine.run(plan)
+        self.history = losses
+        self.record_training_stop()
+        return self.model.with_params(state.params)
+
+
+class DistributedTrainer(Trainer):
+    """Base for multi-worker trainers (reference ``DistributedTrainer``)."""
+
+    def __init__(self, *args, num_workers: Optional[int] = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.num_workers = num_workers
+
+    def _mesh(self):
+        return data_mesh(num_workers=self.num_workers)
+
+
+class SynchronousDistributedTrainer(DistributedTrainer):
+    """Per-step gradient all-reduce (reference ``SynchronousDistributedTrainer``;
+    BASELINE config #5's "synchronous DOWNPOUR" at scale)."""
+
+    def __init__(self, *args, steps_per_program: int = 8, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.steps_per_program = steps_per_program
+
+    def train(self, dataframe: DataFrame, shuffle: bool = False) -> Model:
+        self.record_training_start()
+        mesh = self._mesh()
+        engine = SyncEngine(
+            self.model, self.worker_optimizer, self.loss, mesh,
+            learning_rate=self.learning_rate, compute_dtype=self.compute_dtype,
+            seed=self.seed,
+        )
+        plan = make_batches(
+            dataframe, self.features_col, self.label_col, self.batch_size,
+            num_workers=engine.num_workers, window=self.steps_per_program,
+            num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed,
+        )
+        state, losses = engine.run(plan)
+        self.history = losses
+        self.record_training_stop()
+        return self.model.with_params(state.params)
+
+
+class AsynchronousDistributedTrainer(DistributedTrainer):
+    """Base for the discipline trainers (reference
+    ``AsynchronousDistributedTrainer``): K local steps per worker per fold round."""
+
+    def __init__(self, *args, communication_window: int = 5, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.communication_window = communication_window
+
+    def _discipline(self) -> Discipline:
+        raise NotImplementedError
+
+    def _run(self, dataframe: DataFrame, shuffle: bool):
+        mesh = self._mesh()
+        engine = AsyncEngine(
+            self.model, self.worker_optimizer, self.loss, self._discipline(), mesh,
+            window=self.communication_window, learning_rate=self.learning_rate,
+            compute_dtype=self.compute_dtype, seed=self.seed,
+        )
+        plan = make_batches(
+            dataframe, self.features_col, self.label_col, self.batch_size,
+            num_workers=engine.num_workers, window=self.communication_window,
+            num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed,
+        )
+        state, losses = engine.run(plan)
+        self.history = losses
+        return state
+
+    def train(self, dataframe: DataFrame, shuffle: bool = False) -> Model:
+        self.record_training_start()
+        state = self._run(dataframe, shuffle)
+        self.record_training_stop()
+        return self.model.with_params(state.center)
+
+
+class DOWNPOUR(AsynchronousDistributedTrainer):
+    """DOWNPOUR (reference ``DOWNPOUR`` trainer + ``DeltaParameterServer``)."""
+
+    def _discipline(self):
+        return DownpourFold()
+
+
+class ADAG(AsynchronousDistributedTrainer):
+    """ADAG (reference ``ADAG`` trainer + ``ADAGParameterServer``): window-normalized
+    accumulated-gradient commits."""
+
+    def _discipline(self):
+        return ADAGFold()
+
+
+class DynSGD(AsynchronousDistributedTrainer):
+    """DynSGD (reference ``DynSGD`` trainer + ``DynSGDParameterServer``):
+    staleness-scaled folds."""
+
+    def _discipline(self):
+        return DynSGDFold()
+
+
+class AEASGD(AsynchronousDistributedTrainer):
+    """Elastic averaging (reference ``AEASGD``): exploration via persistent local
+    replicas tethered to the center with elastic rate ``α = ρ·learning_rate``."""
+
+    def __init__(self, *args, rho: float = 5.0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.rho = rho
+
+    def _discipline(self):
+        return AEASGDFold(alpha=self.rho * self.learning_rate)
+
+
+class EAMSGD(AsynchronousDistributedTrainer):
+    """EAMSGD (reference ``EAMSGD``): AEASGD with momentum local workers."""
+
+    def __init__(self, *args, rho: float = 5.0, momentum: float = 0.9, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.rho = rho
+        self.momentum = momentum
+        # Momentum lives in the *local* optimizer (reference EAMSGDWorker).
+        if self.worker_optimizer in ("sgd", "momentum", "nesterov"):
+            import optax
+
+            self.worker_optimizer = optax.sgd(
+                self.learning_rate, momentum=self.momentum,
+                nesterov=self.worker_optimizer == "nesterov",
+            )
+        else:
+            import warnings
+
+            warnings.warn(
+                "EAMSGD: momentum kwarg is embedded in the local optimizer; the "
+                f"provided worker_optimizer={self.worker_optimizer!r} is used as-is "
+                "and the momentum argument is ignored",
+                stacklevel=2,
+            )
+
+    def _discipline(self):
+        return EAMSGDFold(alpha=self.rho * self.learning_rate)
+
+
+class AveragingTrainer(DistributedTrainer):
+    """Train independent replicas, average their weights (reference
+    ``AveragingTrainer``): the fold is a single ``pmean`` at the end, here computed
+    from the stacked local replicas."""
+
+    def __init__(self, *args, communication_window: int = 8, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.communication_window = communication_window  # steps per program only
+
+    def train(self, dataframe: DataFrame, shuffle: bool = False) -> Model:
+        self.record_training_start()
+        mesh = self._mesh()
+        engine = AsyncEngine(
+            self.model, self.worker_optimizer, self.loss, EnsembleFold(), mesh,
+            window=self.communication_window, learning_rate=self.learning_rate,
+            compute_dtype=self.compute_dtype, seed=self.seed,
+        )
+        plan = make_batches(
+            dataframe, self.features_col, self.label_col, self.batch_size,
+            num_workers=engine.num_workers, window=self.communication_window,
+            num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed,
+        )
+        state, losses = engine.run(plan)
+        self.history = losses
+        averaged = jax.tree.map(lambda a: jnp.mean(a, axis=0), state.locals_)
+        self.record_training_stop()
+        return self.model.with_params(averaged)
+
+
+class EnsembleTrainer(DistributedTrainer):
+    """Train N independent models, return all of them (reference
+    ``EnsembleTrainer``)."""
+
+    def __init__(self, *args, communication_window: int = 8, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.communication_window = communication_window
+
+    def train(self, dataframe: DataFrame, shuffle: bool = False) -> list[Model]:
+        self.record_training_start()
+        mesh = self._mesh()
+        engine = AsyncEngine(
+            self.model, self.worker_optimizer, self.loss, EnsembleFold(), mesh,
+            window=self.communication_window, learning_rate=self.learning_rate,
+            compute_dtype=self.compute_dtype, seed=self.seed,
+        )
+        plan = make_batches(
+            dataframe, self.features_col, self.label_col, self.batch_size,
+            num_workers=engine.num_workers, window=self.communication_window,
+            num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed,
+        )
+        state, losses = engine.run(plan)
+        self.history = losses
+        self.record_training_stop()
+        stacked = jax.device_get(state.locals_)
+        models = []
+        for i in range(engine.num_workers):
+            params_i = jax.tree.map(lambda a: a[i], stacked)
+            models.append(self.model.with_params(params_i))
+        return models

@@ -1,0 +1,51 @@
+"""Utility parity layer — the reference's ``distkeras/utils.py`` surface.
+
+Functions keep their reference names where behavior maps 1:1 so ported notebooks can
+do ``from distkeras_tpu.utils import ...`` and run.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distkeras_tpu.data.dataframe import DataFrame
+from distkeras_tpu.models.base import Model, uniform_weights  # noqa: F401 (re-export)
+from distkeras_tpu.runtime.serialization import (
+    deserialize_model,
+    serialize_model,
+)
+
+
+def serialize_keras_model(model: Model) -> bytes:
+    """Reference ``utils.serialize_keras_model``: model -> portable bytes."""
+    return serialize_model(model)
+
+
+def deserialize_keras_model(data: bytes) -> Model:
+    """Reference ``utils.deserialize_keras_model``: bytes -> model."""
+    return deserialize_model(data)
+
+
+def shuffle(dataframe: DataFrame, seed: int = 0) -> DataFrame:
+    """Reference ``utils.shuffle(dataframe)``: random row permutation."""
+    return dataframe.shuffle(seed=seed)
+
+
+def precache(dataframe: DataFrame) -> DataFrame:
+    """Reference ``utils.precache``: force materialization (no-op here — numpy
+    columns are always materialized)."""
+    return dataframe.precache()
+
+
+def new_dataframe_row(row: dict, name: str, value) -> dict:
+    """Reference ``utils.new_dataframe_row``: row dict + one new column value."""
+    out = dict(row)
+    out[name] = value
+    return out
+
+
+def to_dense_vector(value, length: int) -> np.ndarray:
+    """Reference ``utils.to_dense_vector``-style helper: one-hot of ``value``."""
+    v = np.zeros((length,), np.float32)
+    v[int(value)] = 1.0
+    return v

@@ -1,0 +1,75 @@
+"""Worker local-step loops.
+
+Parity with ``distkeras/workers.py``: the reference ships a ``Worker.train`` closure
+to each Spark executor, which deserializes the model, compiles it with the worker
+optimizer, and calls ``model.train_on_batch`` per minibatch (SURVEY.md §3.1 hot loop).
+
+Here the "worker" is a pure jitted function: ``communication_window`` minibatch steps
+expressed as one ``lax.scan`` so the whole window is a single XLA program — no Python
+between steps, params stay in HBM/vregs, and XLA can pipeline weight updates against
+the next batch's gradients. Replica divergence (each worker trains on its own slice)
+comes from running this under ``shard_map``, not from separate processes.
+
+The same loop serves both engines: the async engine uses it as-is (grads stay local);
+the sync engine injects a per-step gradient ``pmean`` via ``grad_transform``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+
+def make_local_loop(
+    module,
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    compute_dtype=None,
+    grad_transform: Optional[Callable] = None,
+):
+    """Build ``local_steps(params, opt_state, xs, ys, rng) -> (params, opt_state, losses)``.
+
+    ``xs``/``ys`` are ``[window, batch, ...]``; the scan carries (params, opt_state)
+    across the window — the executor minibatch loop with zero host round-trips.
+    Inputs are cast to ``compute_dtype`` so matmuls hit the MXU natively (params and
+    optimizer state stay float32). ``grad_transform(grads, loss) -> (grads, loss)``
+    runs after each backward pass — the sync engine's gradient all-reduce hook.
+
+    The rng handed in must be identical across replicas if determinism across
+    restarts matters; per-step dropout keys are derived inside the scan.
+    """
+
+    def cast(x):
+        if compute_dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(compute_dtype)
+        return x
+
+    def loss_on_batch(params, x, y, rng):
+        # Always provide a dropout rng: harmless for dropout-free modules, required
+        # for any module that samples (flax raises at trace time otherwise).
+        out = module.apply({"params": params}, cast(x), train=True, rngs={"dropout": rng})
+        return loss_fn(out.astype(jnp.float32), y)
+
+    def local_steps(params, opt_state, xs, ys, rng: Optional[jax.Array] = None):
+        if rng is None:
+            rng = jax.random.key(0)
+
+        def step(carry, batch):
+            p, s, key = carry
+            key, sub = jax.random.split(key)
+            x, y = batch
+            loss, grads = jax.value_and_grad(loss_on_batch)(p, x, y, sub)
+            if grad_transform is not None:
+                grads, loss = grad_transform(grads, loss)
+            updates, s = tx.update(grads, s, p)
+            p = optax.apply_updates(p, updates)
+            return (p, s, key), loss
+
+        (params, opt_state, _), losses = lax.scan(step, (params, opt_state, rng), (xs, ys))
+        return params, opt_state, losses
+
+    return local_steps

@@ -1,0 +1,27 @@
+"""Test bootstrap: simulate an 8-device TPU mesh on CPU.
+
+The reference's only multi-worker test harness was Spark ``local[N]`` (SURVEY.md §4);
+ours is XLA's host-platform device-count flag — every collective and sharding path runs
+as a real 8-device program in CI, no TPU needed.
+
+A pytest plugin in this environment imports jax before conftest runs, so setting env
+vars alone is not enough — jax.config snapshots JAX_PLATFORMS at import. The backend
+itself initializes lazily (first device access), so ``jax.config.update`` here still
+wins as long as no test-collection code touched devices.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.device_count() == 8, (
+    f"virtual CPU mesh not active (got {jax.device_count()} devices on "
+    f"{jax.default_backend()}); a plugin initialized the jax backend before conftest"
+)

@@ -1,0 +1,121 @@
+"""Data-plane tests: DataFrame, transformers, batch planning."""
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.data import (
+    DataFrame,
+    DenseTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    OneHotTransformer,
+    ReshapeTransformer,
+    make_batches,
+)
+
+
+def _df(n=100, d=4):
+    rng = np.random.default_rng(0)
+    return DataFrame(
+        {"features": rng.normal(size=(n, d)).astype(np.float32),
+         "label": rng.integers(0, 3, size=n)}
+    )
+
+
+def test_dataframe_basics():
+    df = _df(10)
+    assert df.count() == 10
+    assert set(df.columns) == {"features", "label"}
+    df2 = df.with_column("x2", df["features"] * 2)
+    assert "x2" in df2 and "x2" not in df
+    assert df2.select("x2").columns == ["x2"]
+    a, b = df.split(0.7, seed=1)
+    assert a.count() == 7 and b.count() == 3
+
+
+def test_dataframe_column_mismatch():
+    with pytest.raises(ValueError):
+        DataFrame({"a": np.zeros(3), "b": np.zeros(4)})
+
+
+def test_shuffle_is_permutation():
+    df = _df(50)
+    sh = df.shuffle(seed=3)
+    assert sorted(sh["label"].tolist()) == sorted(df["label"].tolist())
+    assert not np.array_equal(sh["features"], df["features"])
+
+
+def test_label_index_transformer():
+    df = DataFrame({"label": np.array(["cat", "dog", "cat", "bird"])})
+    t = LabelIndexTransformer(input_col="label", output_col="idx")
+    out = t.transform(df)
+    assert out["idx"].dtype == np.int32
+    assert out["idx"][0] == out["idx"][2]
+    assert len(set(out["idx"].tolist())) == 3
+
+
+def test_one_hot_transformer():
+    df = DataFrame({"label": np.array([0, 2, 1])})
+    out = OneHotTransformer(3, input_col="label", output_col="oh").transform(df)
+    np.testing.assert_array_equal(
+        out["oh"], [[1, 0, 0], [0, 0, 1], [0, 1, 0]]
+    )
+    with pytest.raises(ValueError):
+        OneHotTransformer(2, input_col="label").transform(df)
+
+
+def test_min_max_transformer():
+    df = DataFrame({"features": np.array([[0.0], [255.0]], np.float32)})
+    out = MinMaxTransformer(0.0, 1.0, input_col="features", output_col="n").transform(df)
+    np.testing.assert_allclose(out["n"], [[0.0], [1.0]])
+
+
+def test_reshape_transformer():
+    df = DataFrame({"features": np.zeros((5, 784), np.float32)})
+    out = ReshapeTransformer("features", "img", (28, 28, 1)).transform(df)
+    assert out["img"].shape == (5, 28, 28, 1)
+
+
+def test_dense_transformer_object_column():
+    rows = np.empty(3, object)
+    for i in range(3):
+        rows[i] = [float(i), float(i + 1)]
+    df = DataFrame({"features": rows})
+    out = DenseTransformer(input_col="features", output_col="d").transform(df)
+    assert out["d"].shape == (3, 2) and out["d"].dtype == np.float32
+
+
+def test_make_batches_layout():
+    df = _df(100, d=4)
+    plan = make_batches(df, "features", "label", batch_size=3, num_workers=4,
+                        window=2, num_epoch=2)
+    # per round = 4*2*3 = 24 rows; 100//24 = 4 rounds/epoch, 2 epochs
+    assert plan.index.shape == (8, 4, 2, 3)
+    fx, fy = plan.round(0)
+    assert fx.shape == (4, 2, 3, 4) and fy.shape == (4, 2, 3)
+    assert plan.num_rounds == 8
+    assert plan.rows_used == 2 * 96
+    # worker-major: round 0, worker 1's first row is global row 6 (no shuffle)
+    np.testing.assert_array_equal(fx[1, 0, 0], df["features"][6])
+
+
+def test_make_batches_too_small():
+    with pytest.raises(ValueError):
+        make_batches(_df(10), "features", "label", batch_size=8, num_workers=4, window=2)
+
+
+def test_make_batches_shuffle_differs_by_epoch():
+    df = _df(48, d=2)
+    plan = make_batches(df, "features", "label", batch_size=2, num_workers=2,
+                        window=2, num_epoch=2, shuffle=True, seed=0)
+    half = plan.num_rounds // 2
+    assert not np.array_equal(plan.index[:half], plan.index[half:])
+
+
+def test_make_batches_stores_one_copy():
+    df = _df(96, d=4)
+    plan = make_batches(df, "features", "label", batch_size=4, num_workers=4,
+                        window=2, num_epoch=50)
+    # 50 epochs must not copy the dataset 50x: only indices scale with epochs
+    assert plan.x.shape == (96, 4)
+    assert plan.index.shape[0] == 3 * 50

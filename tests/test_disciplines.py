@@ -1,0 +1,187 @@
+"""Discipline-fold semantics tests.
+
+Strategy (SURVEY.md §4 "build consequence"): each fold rule is verified against a
+hand-rolled numpy/optax re-execution of the same schedule — the kind of
+numerical-equivalence testing the reference never had.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distkeras_tpu.data import DataFrame, make_batches
+from distkeras_tpu.models import Model, mnist_mlp
+from distkeras_tpu.models.mlp import MLP
+from distkeras_tpu.ops.losses import get_loss
+from distkeras_tpu.parallel.disciplines import (
+    ADAGFold,
+    AEASGDFold,
+    DownpourFold,
+    DynSGDFold,
+    EnsembleFold,
+)
+from distkeras_tpu.parallel.engine import AsyncEngine
+from distkeras_tpu.parallel.sync import SyncEngine
+from distkeras_tpu.runtime.mesh import data_mesh
+
+W, K, B, D, C = 4, 2, 4, 6, 3  # workers, window, batch, features, classes
+
+
+def tiny_model(seed=0):
+    module = MLP(hidden=(8,), num_outputs=C)
+    return Model.build(module, jnp.zeros((1, D), jnp.float32), seed=seed)
+
+
+def tiny_df(n=W * K * B * 3):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, D)).astype(np.float32)
+    y = rng.integers(0, C, size=n).astype(np.int32)
+    return DataFrame({"features": x, "label": y})
+
+
+def manual_local_steps(module, params, xs, ys, lr):
+    """Reference re-implementation of the worker hot loop with plain optax sgd."""
+    loss_fn = get_loss("sparse_categorical_crossentropy")
+    tx = optax.sgd(lr)
+    opt = tx.init(params)
+
+    def loss_of(p, x, y):
+        return loss_fn(module.apply({"params": p}, x, train=True), y)
+
+    for k in range(xs.shape[0]):
+        grads = jax.grad(loss_of)(params, xs[k], ys[k])
+        updates, opt = tx.update(grads, opt, params)
+        params = optax.apply_updates(params, updates)
+    return params
+
+
+def run_one_round(discipline, lr=0.05):
+    model = tiny_model()
+    mesh = data_mesh(num_workers=W)
+    engine = AsyncEngine(model, "sgd", "sparse_categorical_crossentropy",
+                         discipline, mesh, window=K, learning_rate=lr)
+    df = tiny_df()
+    plan = make_batches(df, "features", "label", B, num_workers=W, window=K)
+    state = engine.init_state()
+    new_state, _ = engine._round_fn(state, *engine._put_batch(*plan.round(0)))
+    return model, plan, new_state, lr
+
+
+def per_worker_deltas(model, plan, lr):
+    fx, fy = plan.round(0)
+    deltas = []
+    for i in range(W):
+        local = manual_local_steps(model.module, model.params, fx[i], fy[i], lr)
+        deltas.append(jax.tree.map(lambda a, b: a - b, local, model.params))
+    return deltas
+
+
+def tree_close(a, b, atol=1e-5):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=atol, rtol=1e-4)
+
+
+def test_downpour_fold_sums_deltas():
+    model, plan, state, lr = run_one_round(DownpourFold())
+    deltas = per_worker_deltas(model, plan, lr)
+    expect = model.params
+    for d in deltas:
+        expect = jax.tree.map(jnp.add, expect, d)
+    tree_close(state.center, expect)
+    # pull semantics: every local replica equals the new center
+    for i in range(W):
+        tree_close(jax.tree.map(lambda a: a[i], state.locals_), state.center)
+
+
+def test_adag_fold_normalizes_by_window():
+    model, plan, state, lr = run_one_round(ADAGFold())
+    deltas = per_worker_deltas(model, plan, lr)
+    expect = model.params
+    for d in deltas:
+        expect = jax.tree.map(lambda e, x: e + x / K, expect, d)
+    tree_close(state.center, expect)
+
+
+def test_dynsgd_fold_staleness_weights():
+    model, plan, state, lr = run_one_round(DynSGDFold())
+    deltas = per_worker_deltas(model, plan, lr)
+    expect = model.params
+    for i, d in enumerate(deltas):
+        expect = jax.tree.map(lambda e, x, w=1.0 / (i + 1): e + w * x, expect, d)
+    tree_close(state.center, expect)
+
+
+def test_aeasgd_fold_elastic_symmetry():
+    rho = 0.25
+    model, plan, state, lr = run_one_round(AEASGDFold(alpha=rho))
+    deltas = per_worker_deltas(model, plan, lr)
+    # center' = center + rho * sum_i (local_i - center)
+    expect_center = model.params
+    for d in deltas:
+        expect_center = jax.tree.map(lambda e, x: e + rho * x, expect_center, d)
+    tree_close(state.center, expect_center)
+    # local_i' = local_i - rho*(local_i - center): moved toward old center
+    for i, d in enumerate(deltas):
+        local_after = jax.tree.map(lambda p, x: p + x, model.params, d)
+        expect_local = jax.tree.map(lambda l, x: l - rho * x, local_after, d)
+        tree_close(jax.tree.map(lambda a: a[i], state.locals_), expect_local)
+
+
+def test_ensemble_fold_no_communication():
+    model, plan, state, lr = run_one_round(EnsembleFold())
+    tree_close(state.center, model.params)  # center untouched
+    deltas = per_worker_deltas(model, plan, lr)
+    for i, d in enumerate(deltas):
+        expect_local = jax.tree.map(lambda p, x: p + x, model.params, d)
+        tree_close(jax.tree.map(lambda a: a[i], state.locals_), expect_local)
+
+
+def test_sync_engine_matches_large_batch_sgd():
+    """W-chip sync DP ≡ single-chip SGD on the W-times-larger batch (SURVEY.md §4)."""
+    lr = 0.1
+    model = tiny_model()
+    df = tiny_df()
+    mesh = data_mesh(num_workers=W)
+    engine = SyncEngine(model, "sgd", "sparse_categorical_crossentropy", mesh,
+                        learning_rate=lr)
+    plan = make_batches(df, "features", "label", B, num_workers=W, window=K)
+    state, _ = engine.run(plan)
+
+    # Manual: same schedule, global batch = concat over workers per step.
+    params = model.params
+    loss_fn = get_loss("sparse_categorical_crossentropy")
+    tx = optax.sgd(lr)
+    opt = tx.init(params)
+
+    def loss_of(p, x, y):
+        return loss_fn(model.module.apply({"params": p}, x, train=True), y)
+
+    for r in range(plan.num_rounds):
+        fx, fy = plan.round(r)
+        for k in range(K):
+            x = fx[:, k].reshape(-1, D)
+            y = fy[:, k].reshape(-1)
+            grads = jax.grad(loss_of)(params, x, y)
+            updates, opt = tx.update(grads, opt, params)
+            params = optax.apply_updates(params, updates)
+    tree_close(state.params, params, atol=1e-4)
+
+
+def test_downpour_single_worker_equals_sgd():
+    """With W=1, DOWNPOUR's fold (center += delta) is exactly plain SGD."""
+    lr = 0.05
+    model = tiny_model()
+    df = tiny_df()
+    mesh = data_mesh(num_workers=1)
+    engine = AsyncEngine(model, "sgd", "sparse_categorical_crossentropy",
+                         DownpourFold(), mesh, window=K, learning_rate=lr)
+    plan = make_batches(df, "features", "label", B, num_workers=1, window=K)
+    state, _ = engine.run(plan)
+
+    params = model.params
+    for r in range(plan.num_rounds):
+        fx, fy = plan.round(r)
+        params = manual_local_steps(model.module, params, fx[0], fy[0], lr)
+    tree_close(state.center, params, atol=1e-4)

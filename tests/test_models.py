@@ -1,0 +1,49 @@
+"""Model zoo shape/forward tests (tiny sizes — CI runs on a 2-core CPU)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distkeras_tpu.models import (
+    small_transformer_lm,
+)
+from distkeras_tpu.models.cnn import cifar10_cnn, mnist_cnn
+from distkeras_tpu.models.lstm import imdb_lstm
+from distkeras_tpu.models.mlp import mnist_mlp
+from distkeras_tpu.models.resnet import tiny_resnet
+
+
+def test_mlp_forward():
+    m = mnist_mlp(hidden=(16,))
+    out = m.predict(jnp.ones((4, 784)))
+    assert out.shape == (4, 10)
+
+
+def test_cnn_forward():
+    m = mnist_cnn()
+    assert m.predict(jnp.ones((2, 28, 28, 1))).shape == (2, 10)
+    m = cifar10_cnn()
+    assert m.predict(jnp.ones((2, 32, 32, 3))).shape == (2, 10)
+
+
+def test_lstm_forward():
+    m = imdb_lstm(vocab_size=50, embed_dim=8, hidden_size=8, seq_len=12)
+    tokens = jnp.zeros((3, 12), jnp.int32)
+    assert m.predict(tokens).shape == (3, 2)
+
+
+def test_resnet_forward():
+    m = tiny_resnet()
+    assert m.predict(jnp.ones((2, 32, 32, 3))).shape == (2, 10)
+
+
+def test_transformer_forward_and_causality():
+    m = small_transformer_lm(vocab_size=64, num_layers=1, d_model=32, num_heads=2,
+                             d_ff=64, max_seq_len=32, seq_len=16)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    out = m.predict(tokens)
+    assert out.shape == (2, 16, 64)
+    # Causality: changing a late token must not affect early logits.
+    t2 = tokens.at[:, 10].set(5)
+    out2 = m.predict(t2)
+    np.testing.assert_allclose(np.asarray(out[:, :10]), np.asarray(out2[:, :10]), atol=1e-5)
+    assert not np.allclose(np.asarray(out[:, 10:]), np.asarray(out2[:, 10:]))

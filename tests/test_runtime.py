@@ -1,0 +1,73 @@
+"""Core runtime tests: mesh construction, model serialization round-trip."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distkeras_tpu import (
+    DATA_AXIS,
+    data_mesh,
+    deserialize_model,
+    hybrid_mesh,
+    serialize_model,
+)
+from distkeras_tpu.models import Model, mnist_mlp, mnist_cnn
+from distkeras_tpu.models.base import uniform_weights
+
+
+def test_virtual_mesh_has_8_devices():
+    assert jax.device_count() == 8  # conftest forced the CPU mesh
+
+
+def test_data_mesh_num_workers():
+    mesh = data_mesh(num_workers=4)
+    assert mesh.shape == {DATA_AXIS: 4}
+    full = data_mesh()
+    assert full.shape == {DATA_AXIS: 8}
+
+
+def test_data_mesh_too_many_workers():
+    with pytest.raises(ValueError):
+        data_mesh(num_workers=99)
+
+
+def test_hybrid_mesh_inference():
+    mesh = hybrid_mesh({"data": -1, "model": 2})
+    assert mesh.shape == {"data": 4, "model": 2}
+
+
+def test_model_serialization_roundtrip():
+    model = mnist_mlp(hidden=(16, 8))
+    blob = model.serialize()
+    assert isinstance(blob, bytes)
+    restored = deserialize_model(blob)
+    assert type(restored.module).__name__ == "MLP"
+    assert restored.module.hidden == (16, 8)
+    x = jnp.ones((2, 784), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(model.predict(x)), np.asarray(restored.predict(x)), rtol=1e-6
+    )
+
+
+def test_serialized_model_predicts_after_reinit():
+    model = mnist_cnn()
+    restored = deserialize_model(serialize_model(model))
+    x = jnp.ones((2, 28, 28, 1), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(model.predict(x)), np.asarray(restored.predict(x)), rtol=1e-6
+    )
+
+
+def test_uniform_weights_bounds():
+    model = mnist_mlp(hidden=(8,))
+    model = uniform_weights(model, bounds=(-0.1, 0.1), seed=1)
+    for leaf in jax.tree.leaves(model.params):
+        arr = np.asarray(leaf)
+        assert arr.min() >= -0.1 and arr.max() <= 0.1
+
+
+def test_num_params_counts():
+    model = mnist_mlp(hidden=(16,))
+    # 784*16 + 16 + 16*10 + 10
+    assert model.num_params == 784 * 16 + 16 + 16 * 10 + 10
