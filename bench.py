@@ -1,0 +1,91 @@
+"""Benchmark: MNIST-CNN under ADAG — samples/sec/chip (BASELINE config #2).
+
+Runs on whatever accelerator jax exposes (the driver runs it on real TPU). Prints ONE
+JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` is vs. the driver-defined target in BASELINE.md; the reference
+publishes no throughput numbers (BASELINE.json ``published: {}``), so the ratio is
+against our own first-round recorded value when present (BENCH_r1.json), else 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from distkeras_tpu.data import DataFrame
+    from distkeras_tpu.models.cnn import mnist_cnn
+    from distkeras_tpu.parallel.disciplines import ADAGFold
+    from distkeras_tpu.parallel.engine import AsyncEngine
+    from distkeras_tpu.data.batching import make_batches
+    from distkeras_tpu.runtime.mesh import data_mesh
+
+    num_chips = jax.device_count()
+    batch_size = 256
+    window = 8
+    warmup_rounds = 4
+    timed_rounds = 40
+
+    # Synthetic MNIST-shaped data (zero-egress environment; shapes are what matter
+    # for throughput).
+    rng = np.random.default_rng(0)
+    n = num_chips * window * batch_size * 8
+    x = rng.random(size=(n, 28, 28, 1), dtype=np.float32)
+    y = rng.integers(0, 10, size=n).astype(np.int32)
+    df = DataFrame({"features": x, "label": y})
+
+    model = mnist_cnn()
+    mesh = data_mesh()
+    engine = AsyncEngine(
+        model, "sgd", "sparse_categorical_crossentropy", ADAGFold(), mesh,
+        window=window, learning_rate=0.01, compute_dtype="bfloat16",
+    )
+    plan = make_batches(df, "features", "label", batch_size,
+                        num_workers=num_chips, window=window, num_epoch=1)
+
+    state = engine.init_state()
+    # Pre-stage every round's batch on device so input transfer isn't benchmarked
+    # (the data plane streams asynchronously in real training).
+    rounds = [engine._put_batch(*plan.round(r % plan.num_rounds))
+              for r in range(warmup_rounds + timed_rounds)]
+
+    for r in range(warmup_rounds):
+        state, loss = engine._round_fn(state, *rounds[r])
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for r in range(warmup_rounds, warmup_rounds + timed_rounds):
+        state, loss = engine._round_fn(state, *rounds[r])
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+
+    samples = timed_rounds * num_chips * window * batch_size
+    sps_per_chip = samples / elapsed / num_chips
+
+    vs = 1.0
+    ref_file = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_r1.json")
+    try:
+        with open(ref_file) as f:
+            prev = json.load(f)
+        if prev.get("value"):
+            vs = sps_per_chip / float(prev["value"])
+    except (OSError, ValueError):
+        pass
+
+    print(json.dumps({
+        "metric": "mnist_cnn_adag_samples_per_sec_per_chip",
+        "value": round(sps_per_chip, 1),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
