@@ -1,0 +1,79 @@
+"""Metrics over prediction DataFrames — parity with ``distkeras/evaluators.py``.
+
+The reference's ``AccuracyEvaluator`` compares a prediction column with a label
+column over a Spark DataFrame; its notebooks also lean on Spark-ML's
+MulticlassClassificationEvaluator (F1). Both live here as plain columnar numpy —
+evaluation is a host-side reduction, not an accelerator workload.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distkeras_tpu.data.dataframe import DataFrame
+
+
+def _to_class_indices(col: np.ndarray) -> np.ndarray:
+    col = np.asarray(col)
+    if col.ndim > 1 and col.shape[-1] > 1:  # logits / probabilities / one-hot
+        return col.argmax(axis=-1)
+    return col.reshape(-1).astype(np.int64)
+
+
+class Evaluator:
+    """Base: ``evaluate(df) -> float``."""
+
+    def __init__(self, prediction_col: str = "prediction", label_col: str = "label"):
+        self.prediction_col = prediction_col
+        self.label_col = label_col
+
+    def evaluate(self, dataframe: DataFrame) -> float:
+        raise NotImplementedError
+
+
+class AccuracyEvaluator(Evaluator):
+    """Fraction of rows whose predicted class equals the label.
+
+    Parity: reference ``AccuracyEvaluator(prediction_col, label_col)``. Accepts raw
+    logits, probabilities, one-hot, or integer columns on either side.
+    """
+
+    def evaluate(self, dataframe: DataFrame) -> float:
+        pred = _to_class_indices(dataframe[self.prediction_col])
+        label = _to_class_indices(dataframe[self.label_col])
+        return float((pred == label).mean())
+
+
+class F1Evaluator(Evaluator):
+    """Macro-averaged F1 (the notebooks' Spark-ML MulticlassClassificationEvaluator
+    equivalent)."""
+
+    def evaluate(self, dataframe: DataFrame) -> float:
+        pred = _to_class_indices(dataframe[self.prediction_col])
+        label = _to_class_indices(dataframe[self.label_col])
+        scores = []
+        for c in np.unique(label):
+            tp = np.sum((pred == c) & (label == c))
+            fp = np.sum((pred == c) & (label != c))
+            fn = np.sum((pred != c) & (label == c))
+            denom = 2 * tp + fp + fn
+            scores.append(2 * tp / denom if denom else 0.0)
+        return float(np.mean(scores))
+
+
+class LossEvaluator(Evaluator):
+    """Mean loss of a prediction column vs labels under a registry loss."""
+
+    def __init__(self, loss: str = "sparse_categorical_crossentropy",
+                 prediction_col: str = "prediction", label_col: str = "label"):
+        super().__init__(prediction_col, label_col)
+        from distkeras_tpu.ops.losses import get_loss
+
+        self.loss_fn = get_loss(loss)
+
+    def evaluate(self, dataframe: DataFrame) -> float:
+        import jax.numpy as jnp
+
+        pred = jnp.asarray(dataframe[self.prediction_col])
+        label = jnp.asarray(dataframe[self.label_col])
+        return float(self.loss_fn(pred, label))

@@ -1,0 +1,90 @@
+"""Structured training metrics + profiling hooks.
+
+SURVEY.md §5: the reference records wall-clock only (``Trainer.record_training_start/
+stop``) with print-level logging. Here every fold round can emit a JSONL record
+(loss, samples/sec/chip, scaling efficiency inputs) and any span can be wrapped in a
+``jax.profiler`` trace for Perfetto/XProf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Optional
+
+import jax
+
+
+class MetricsLogger:
+    """Per-round JSONL metrics writer with throughput accounting.
+
+    Use as the ``on_round`` callback of an engine run::
+
+        logger = MetricsLogger("run.jsonl", samples_per_round=W*K*B, num_chips=W)
+        engine.run(plan, on_round=logger)
+    """
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        samples_per_round: int = 0,
+        num_chips: int = 1,
+        extra: Optional[dict] = None,
+    ):
+        self.path = path
+        self.samples_per_round = samples_per_round
+        self.num_chips = num_chips
+        self.extra = extra or {}
+        self.records: list[dict] = []
+        self._file = open(path, "a") if path else None
+        self._last_t = time.perf_counter()
+
+    def __call__(self, round_idx: int, loss) -> None:
+        now = time.perf_counter()
+        dt = now - self._last_t
+        self._last_t = now
+        rec = {
+            "ts": time.time(),
+            "round": round_idx,
+            "loss": float(loss),
+            "round_seconds": round(dt, 6),
+            **self.extra,
+        }
+        if self.samples_per_round and dt > 0:
+            rec["samples_per_sec"] = round(self.samples_per_round / dt, 2)
+            rec["samples_per_sec_per_chip"] = round(
+                self.samples_per_round / dt / self.num_chips, 2
+            )
+        self.records.append(rec)
+        if self._file:
+            self._file.write(json.dumps(rec) + "\n")
+            self._file.flush()
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()
+            self._file = None
+
+    def mean_throughput(self, skip: int = 1) -> float:
+        """Mean samples/sec over recorded rounds, skipping the first (compile)."""
+        vals = [r["samples_per_sec"] for r in self.records[skip:]
+                if "samples_per_sec" in r]
+        return sum(vals) / len(vals) if vals else 0.0
+
+
+def scaling_efficiency(sps_n: float, sps_1: float, n_chips: int) -> float:
+    """BASELINE.md's headline metric: throughput(N) / (N * throughput(1))."""
+    if sps_1 <= 0 or n_chips <= 0:
+        return 0.0
+    return sps_n / (n_chips * sps_1)
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """``jax.profiler`` span -> Perfetto/XProf trace in ``log_dir``."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

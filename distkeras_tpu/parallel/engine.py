@@ -157,9 +157,16 @@ class AsyncEngine:
         self,
         plan: BatchPlan,
         state: Optional[EngineState] = None,
-        on_round: Optional[Callable[[int, float], None]] = None,
+        start_round: int = 0,
+        on_round: Optional[Callable] = None,
     ):
-        """Execute every fold round in ``plan``. Returns (state, losses [num_rounds])."""
+        """Execute fold rounds ``start_round..num_rounds`` (resume-aware).
+
+        Returns (state, losses). ``on_round(r, loss, state)`` fires after each round
+        — note ``state`` buffers are donated into the *next* round, so callbacks
+        that persist state must finish reading it before returning (the
+        Checkpointer saves with ``wait=True`` for exactly this reason).
+        """
         if plan.num_workers != self.num_workers:
             raise ValueError(
                 f"plan built for {plan.num_workers} workers, mesh has {self.num_workers}"
@@ -167,10 +174,11 @@ class AsyncEngine:
         if state is None:
             state = self.init_state()
         losses = []
-        for r in range(plan.num_rounds):
+        for r in range(start_round, plan.num_rounds):
             xs, ys = self._put_batch(*plan.round(r))
-            state, loss = self._round_fn(state, xs, ys)
+            new_state, loss = self._round_fn(state, xs, ys)
             losses.append(loss)
             if on_round is not None:
-                on_round(r, loss)
+                on_round(r, loss, new_state)
+            state = new_state
         return state, np.asarray([float(l) for l in losses])

@@ -106,8 +106,11 @@ class SyncEngine:
         self,
         plan: BatchPlan,
         state: Optional[SyncState] = None,
-        on_round: Optional[Callable[[int, float], None]] = None,
+        start_round: int = 0,
+        on_round: Optional[Callable] = None,
     ):
+        """Execute rounds ``start_round..num_rounds``; ``on_round(r, loss, state)``
+        (see AsyncEngine.run for the donation caveat)."""
         if plan.num_workers != self.num_workers:
             raise ValueError(
                 f"plan built for {plan.num_workers} workers, mesh has {self.num_workers}"
@@ -116,12 +119,13 @@ class SyncEngine:
             state = self.init_state()
         shard = NamedSharding(self.mesh, P(DATA_AXIS))
         losses = []
-        for r in range(plan.num_rounds):
+        for r in range(start_round, plan.num_rounds):
             fx, fy = plan.round(r)
             xs = jax.device_put(fx, shard)
             ys = jax.device_put(fy, shard)
-            state, loss = self._round_fn(state, xs, ys)
+            new_state, loss = self._round_fn(state, xs, ys)
             losses.append(loss)
             if on_round is not None:
-                on_round(r, loss)
+                on_round(r, loss, new_state)
+            state = new_state
         return state, np.asarray([float(l) for l in losses])

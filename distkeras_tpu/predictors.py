@@ -1,0 +1,90 @@
+"""Distributed inference — parity with ``distkeras/predictors.py``.
+
+The reference maps a deserialized model's ``predict`` over Spark partitions and
+appends a prediction column (``ModelPredictor.predict(df)``, SURVEY.md §3.5). Here the
+batch axis is sharded over the ``data`` mesh axis and the forward pass is one jitted
+program per chunk; rows are padded to a fixed chunk size so every chunk hits the same
+compiled executable (no shape-polymorphic recompiles).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.data.dataframe import DataFrame
+from distkeras_tpu.models.base import Model
+from distkeras_tpu.runtime.mesh import DATA_AXIS, data_mesh
+
+
+class Predictor:
+    """Base: ``predict(df) -> df`` with a new output column."""
+
+    def predict(self, dataframe: DataFrame) -> DataFrame:
+        raise NotImplementedError
+
+
+class ModelPredictor(Predictor):
+    """Append ``output_col`` with the model's raw outputs (logits).
+
+    Parity: reference ``ModelPredictor(keras_model, features_col, output_col)``.
+    ``chunk_size`` is the per-program global batch; rows are padded up then trimmed.
+    """
+
+    def __init__(
+        self,
+        model: Model,
+        features_col: str = "features",
+        output_col: str = "prediction",
+        chunk_size: int = 1024,
+        num_workers: Optional[int] = None,
+    ):
+        self.model = model
+        self.features_col = features_col
+        self.output_col = output_col
+        self.num_workers = num_workers
+        self.mesh = data_mesh(num_workers=num_workers)
+        W = self.mesh.shape[DATA_AXIS]
+        self.chunk_size = max(chunk_size // W, 1) * W  # divisible by worker count
+        self._fwd = jax.jit(
+            lambda params, x: self.model.module.apply({"params": params}, x, train=False)
+        )
+        rep = NamedSharding(self.mesh, P())
+        self._params = jax.device_put(self.model.params, rep)
+        self._shard = NamedSharding(self.mesh, P(DATA_AXIS))
+
+    def predict(self, dataframe: DataFrame) -> DataFrame:
+        x = np.asarray(dataframe[self.features_col])
+        n = len(x)
+        outs = []
+        for start in range(0, n, self.chunk_size):
+            chunk = x[start : start + self.chunk_size]
+            pad = self.chunk_size - len(chunk)
+            if pad:
+                chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad, axis=0)])
+            xb = jax.device_put(jnp.asarray(chunk), self._shard)
+            out = np.asarray(self._fwd(self._params, xb))
+            outs.append(out[: len(out) - pad] if pad else out)
+        return dataframe.with_column(self.output_col, np.concatenate(outs, axis=0))
+
+
+class ProbabilityPredictor(ModelPredictor):
+    """Like ModelPredictor but appends softmax probabilities."""
+
+    def predict(self, dataframe: DataFrame) -> DataFrame:
+        df = super().predict(dataframe)
+        probs = jax.nn.softmax(jnp.asarray(df[self.output_col]), axis=-1)
+        return df.with_column(self.output_col, np.asarray(probs))
+
+
+class ClassPredictor(ModelPredictor):
+    """Appends the argmax class index (the notebooks' common final step)."""
+
+    def predict(self, dataframe: DataFrame) -> DataFrame:
+        df = super().predict(dataframe)
+        cls = np.asarray(df[self.output_col]).argmax(axis=-1).astype(np.int32)
+        return df.with_column(self.output_col, cls)

@@ -63,6 +63,10 @@ class Trainer:
         learning_rate: float = 0.01,
         compute_dtype: Optional[str] = None,
         seed: int = 0,
+        metrics_path: Optional[str] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 0,
+        resume: bool = False,
     ):
         self.model = model
         self.worker_optimizer = worker_optimizer
@@ -74,9 +78,55 @@ class Trainer:
         self.learning_rate = learning_rate
         self.compute_dtype = _DTYPES[compute_dtype] if isinstance(compute_dtype, (str, type(None))) else compute_dtype
         self.seed = seed
+        self.metrics_path = metrics_path
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = checkpoint_every
+        self.resume = resume
         self.history: np.ndarray | None = None
         self.training_time: float = 0.0
         self._t_start: float | None = None
+
+    def _execute(self, engine, plan):
+        """Shared run harness: resume from checkpoint, per-round metrics/saves."""
+        state = engine.init_state()
+        start = 0
+        ckpt = logger = None
+        if self.checkpoint_dir:
+            from distkeras_tpu.checkpoint import Checkpointer
+
+            ckpt = Checkpointer(self.checkpoint_dir)
+            if self.resume and ckpt.latest_step() is not None:
+                latest = ckpt.latest_step()
+                state = ckpt.restore(state, step=latest)
+                start = latest + 1
+        if self.metrics_path:
+            from distkeras_tpu.metrics import MetricsLogger
+
+            logger = MetricsLogger(
+                self.metrics_path,
+                samples_per_round=plan.samples_per_round,
+                num_chips=plan.num_workers,
+                extra={"trainer": type(self).__name__},
+            )
+
+        def on_round(r, loss, st):
+            if logger is not None:
+                logger(r, loss)
+            if ckpt is not None and self.checkpoint_every and (
+                (r + 1) % self.checkpoint_every == 0 or r == plan.num_rounds - 1
+            ):
+                # wait=True: the engine donates state buffers into the next round;
+                # the write must complete before training continues.
+                ckpt.save(r, st, wait=True)
+
+        state, losses = engine.run(plan, state=state, start_round=start,
+                                   on_round=on_round)
+        if ckpt is not None:
+            ckpt.close()
+        if logger is not None:
+            logger.close()
+        self.history = losses
+        return state
 
     # -- timing parity (reference Trainer.record_training_start/stop) -------
     def record_training_start(self):
@@ -116,8 +166,7 @@ class SingleTrainer(Trainer):
             num_workers=1, window=self.steps_per_program, num_epoch=self.num_epoch,
             shuffle=shuffle, seed=self.seed,
         )
-        state, losses = engine.run(plan)
-        self.history = losses
+        state = self._execute(engine, plan)
         self.record_training_stop()
         return self.model.with_params(state.params)
 
@@ -154,8 +203,7 @@ class SynchronousDistributedTrainer(DistributedTrainer):
             num_workers=engine.num_workers, window=self.steps_per_program,
             num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed,
         )
-        state, losses = engine.run(plan)
-        self.history = losses
+        state = self._execute(engine, plan)
         self.record_training_stop()
         return self.model.with_params(state.params)
 
@@ -183,9 +231,7 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
             num_workers=engine.num_workers, window=self.communication_window,
             num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed,
         )
-        state, losses = engine.run(plan)
-        self.history = losses
-        return state
+        return self._execute(engine, plan)
 
     def train(self, dataframe: DataFrame, shuffle: bool = False) -> Model:
         self.record_training_start()
@@ -280,8 +326,7 @@ class AveragingTrainer(DistributedTrainer):
             num_workers=engine.num_workers, window=self.communication_window,
             num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed,
         )
-        state, losses = engine.run(plan)
-        self.history = losses
+        state = self._execute(engine, plan)
         averaged = jax.tree.map(lambda a: jnp.mean(a, axis=0), state.locals_)
         self.record_training_stop()
         return self.model.with_params(averaged)
@@ -308,8 +353,7 @@ class EnsembleTrainer(DistributedTrainer):
             num_workers=engine.num_workers, window=self.communication_window,
             num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed,
         )
-        state, losses = engine.run(plan)
-        self.history = losses
+        state = self._execute(engine, plan)
         self.record_training_stop()
         stacked = jax.device_get(state.locals_)
         models = []
