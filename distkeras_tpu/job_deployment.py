@@ -1,0 +1,83 @@
+"""Multi-host job deployment — parity with ``distkeras/job_deployment.py``.
+
+The reference's ``Job``/``Punchcard`` wrap "ssh to a gateway, spark-submit a script
+with a JSON job description" (SURVEY.md §2 L0). The TPU equivalent launches the same
+script on every host of a pod slice with the ``jax.distributed`` coordinator
+environment set; hosts then self-assemble over DCN (``runtime.mesh.
+distributed_initialize``). ``spark-submit --num-executors N`` becomes "one process per
+TPU host, N = process_count x chips_per_host".
+
+Launching is via ssh (TPU-VM style) or a user-supplied runner; ``dry_run`` renders
+the exact per-host command lines without executing (and is all that CI exercises).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import shlex
+import subprocess
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass
+class Punchcard:
+    """Portable job description (reference ``Punchcard``: the JSON job card)."""
+
+    job_name: str
+    script: str
+    hosts: Sequence[str]
+    coordinator_port: int = 8476
+    env: dict = dataclasses.field(default_factory=dict)
+    args: Sequence[str] = ()
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Punchcard":
+        return cls(**json.loads(text))
+
+
+class Job:
+    """Render + launch a multi-host training job (reference ``Job``)."""
+
+    def __init__(self, punchcard: Punchcard, ssh_user: Optional[str] = None):
+        self.punchcard = punchcard
+        self.ssh_user = ssh_user
+        self._procs: list[subprocess.Popen] = []
+
+    def render_commands(self) -> list[str]:
+        """One command line per host, with the jax.distributed bootstrap env."""
+        pc = self.punchcard
+        coordinator = f"{pc.hosts[0]}:{pc.coordinator_port}"
+        cmds = []
+        for i, _host in enumerate(pc.hosts):
+            env = {
+                "JAX_COORDINATOR_ADDRESS": coordinator,
+                "JAX_NUM_PROCESSES": str(len(pc.hosts)),
+                "JAX_PROCESS_ID": str(i),
+                **pc.env,
+            }
+            env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+            arg_str = " ".join(shlex.quote(a) for a in pc.args)
+            cmds.append(f"env {env_str} python {shlex.quote(pc.script)} {arg_str}".strip())
+        return cmds
+
+    def launch(self, dry_run: bool = True) -> list[str]:
+        """Start the job on every host; with ``dry_run`` just return the commands."""
+        cmds = self.render_commands()
+        if dry_run:
+            return cmds
+        for host, cmd in zip(self.punchcard.hosts, cmds):
+            target = f"{self.ssh_user}@{host}" if self.ssh_user else host
+            if host in ("localhost", "127.0.0.1"):
+                self._procs.append(subprocess.Popen(cmd, shell=True))
+            else:
+                self._procs.append(
+                    subprocess.Popen(["ssh", target, cmd])
+                )
+        return cmds
+
+    def wait(self) -> list[int]:
+        return [p.wait() for p in self._procs]

@@ -1,0 +1,50 @@
+"""BASELINE config #3: CIFAR-10 CNN under AEASGD (elastic averaging on ICI).
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/cifar10_aeasgd.py --workers 8 --epochs 2
+"""
+
+import argparse
+
+import distkeras_tpu as dk
+from distkeras_tpu.datasets import cifar10
+from distkeras_tpu.evaluators import AccuracyEvaluator
+from distkeras_tpu.models.cnn import cifar10_cnn
+from distkeras_tpu.predictors import ClassPredictor
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--workers", type=int, default=None)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--window", type=int, default=8)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--rho", type=float, default=3.0)
+    p.add_argument("--rows", type=int, default=8192)
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--metrics", default=None, help="JSONL metrics path")
+    args = p.parse_args()
+
+    df = cifar10(n=args.rows, data_dir=args.data_dir)
+    train_df, test_df = df.split(0.9, seed=1)
+
+    trainer = dk.AEASGD(
+        cifar10_cnn(), worker_optimizer="sgd",
+        loss="sparse_categorical_crossentropy", batch_size=args.batch_size,
+        num_epoch=args.epochs, num_workers=args.workers,
+        communication_window=args.window, learning_rate=args.lr, rho=args.rho,
+        compute_dtype="bfloat16", metrics_path=args.metrics,
+    )
+    trained = trainer.train(train_df, shuffle=True)
+    h = trainer.get_history()
+    print(f"AEASGD: loss {h[0]:.4f} -> {h[-1]:.4f} in {trainer.get_training_time():.1f}s")
+
+    pred = ClassPredictor(trained, features_col="features",
+                          output_col="prediction").predict(test_df)
+    print("test accuracy:", AccuracyEvaluator(prediction_col="prediction",
+                                              label_col="label").evaluate(pred))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""BASELINE config #4: IMDB LSTM sentiment under DynSGD (staleness-aware folds).
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/imdb_dynsgd.py --workers 4 --epochs 2
+"""
+
+import argparse
+
+import distkeras_tpu as dk
+from distkeras_tpu.datasets import imdb
+from distkeras_tpu.evaluators import AccuracyEvaluator
+from distkeras_tpu.models.lstm import imdb_lstm
+from distkeras_tpu.predictors import ClassPredictor
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--workers", type=int, default=None)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--window", type=int, default=5)
+    p.add_argument("--lr", type=float, default=0.002)
+    p.add_argument("--rows", type=int, default=8192)
+    p.add_argument("--vocab", type=int, default=2000)
+    p.add_argument("--seq-len", type=int, default=80)
+    p.add_argument("--data-dir", default=None)
+    args = p.parse_args()
+
+    df = imdb(n=args.rows, vocab_size=args.vocab, seq_len=args.seq_len,
+              data_dir=args.data_dir)
+    train_df, test_df = df.split(0.9, seed=1)
+
+    model = imdb_lstm(vocab_size=args.vocab, embed_dim=64, hidden_size=64,
+                      seq_len=args.seq_len)
+    trainer = dk.DynSGD(
+        model, worker_optimizer="adam", loss="sparse_categorical_crossentropy",
+        batch_size=args.batch_size, num_epoch=args.epochs,
+        num_workers=args.workers, communication_window=args.window,
+        learning_rate=args.lr,
+    )
+    trained = trainer.train(train_df, shuffle=True)
+    h = trainer.get_history()
+    print(f"DynSGD: loss {h[0]:.4f} -> {h[-1]:.4f} in {trainer.get_training_time():.1f}s")
+
+    pred = ClassPredictor(trained, features_col="features",
+                          output_col="prediction").predict(test_df)
+    print("test accuracy:", AccuracyEvaluator(prediction_col="prediction",
+                                              label_col="label").evaluate(pred))
+
+
+if __name__ == "__main__":
+    main()
