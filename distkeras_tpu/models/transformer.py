@@ -52,6 +52,14 @@ class CausalSelfAttention(nn.Module):
             from distkeras_tpu.ops.ring_attention import ring_attention
 
             out = ring_attention(q, k, v, axis_name=self.seq_axis)
+        elif self.seq_axis is None and self.attn_impl == "flash":
+            from distkeras_tpu.ops.pallas import flash_attention
+
+            out = flash_attention(
+                q, k, v,
+                block_size=min(128, L),
+                interpret=jax.default_backend() != "tpu",
+            )
         else:
             q_pos = _global_positions(L, self.seq_axis)
             if self.seq_axis is not None:

@@ -1,0 +1,3 @@
+"""Pallas TPU kernels for the framework's hot ops."""
+
+from distkeras_tpu.ops.pallas.flash_attention import flash_attention  # noqa: F401
