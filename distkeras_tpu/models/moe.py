@@ -1,0 +1,151 @@
+"""Mixture-of-Experts transformer: expert parallelism over an ``expert`` mesh axis.
+
+Beyond-reference surface (SURVEY.md §2: EP/MoE absent). Switch-style top-1 routing
+with static capacity: dispatch/combine are one-hot einsums (fully differentiable,
+static shapes — XLA-friendly), expert FFNs are a ``nn.vmap``-stacked bank whose
+leading axis carries the expert id. Expert parallelism is GSPMD-style: shard the
+stacked expert params over the ``expert`` mesh axis (``parallel/sharding.py ->
+MOE_RULES``) and XLA lowers the dispatch/combine einsums into the all-to-alls —
+no hand-written routing collectives to get wrong.
+
+Router aux loss (Switch load-balancing: ``E * sum_e f_e * P_e``) is sown under
+``intermediates/aux_loss`` for trainers that want to add it.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from distkeras_tpu.models.base import DKModule, Model, register_model
+from distkeras_tpu.models.transformer import CausalSelfAttention, _global_positions
+
+
+class ExpertFFN(nn.Module):
+    d_model: int
+    d_ff: int
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.d_ff, name="up")(x)
+        h = nn.gelu(h)
+        return nn.Dense(self.d_model, name="down")(h)
+
+
+class MoEMLP(nn.Module):
+    num_experts: int
+    d_model: int
+    d_ff: int
+    capacity_factor: float = 1.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, L, D = x.shape
+        T = B * L
+        E = self.num_experts
+        C = max(1, math.ceil(self.capacity_factor * T / E))
+        xf = x.reshape(T, D)
+
+        logits = nn.Dense(E, name="router")(xf)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        gate = probs.max(axis=-1)
+        expert = probs.argmax(axis=-1)
+
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [T, E]
+        # position of each token within its expert's queue; overflow is dropped
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
+        keep = (pos < C) * onehot  # [T, E]
+        dispatch = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)
+        dispatch = dispatch * keep[..., None]  # [T, E, C]
+        combine = dispatch * gate[:, None, None]
+
+        # Switch load-balancing aux loss: E * sum_e (token fraction * prob mass).
+        frac = onehot.mean(axis=0)
+        prob_mass = probs.mean(axis=0)
+        self.sow("intermediates", "aux_loss", E * jnp.sum(frac * prob_mass))
+
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, xf.astype(jnp.float32))
+        experts = nn.vmap(
+            ExpertFFN,
+            in_axes=0, out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )(self.d_model, self.d_ff, name="experts")
+        expert_out = experts(expert_in)  # [E, C, D]
+        out = jnp.einsum("tec,ecd->td", combine, expert_out)
+        return out.astype(x.dtype).reshape(B, L, D)
+
+
+class MoETransformerBlock(nn.Module):
+    num_heads: int
+    d_model: int
+    d_ff: int
+    num_experts: int
+    capacity_factor: float = 1.5
+    seq_axis: Optional[str] = None
+    attn_impl: str = "dense"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.LayerNorm(name="ln_attn")(x)
+        h = CausalSelfAttention(self.num_heads, self.d_model,
+                                seq_axis=self.seq_axis, attn_impl=self.attn_impl,
+                                name="attn")(h, train=train)
+        x = x + h
+        h = nn.LayerNorm(name="ln_mlp")(x)
+        h = MoEMLP(self.num_experts, self.d_model, self.d_ff,
+                   capacity_factor=self.capacity_factor, name="moe")(h, train=train)
+        return x + h
+
+
+@register_model
+class MoETransformerLM(DKModule):
+    vocab_size: int = 32000
+    num_layers: int = 4
+    d_model: int = 256
+    num_heads: int = 8
+    d_ff: int = 1024
+    num_experts: int = 8
+    capacity_factor: float = 1.5
+    max_seq_len: int = 2048
+    seq_axis: Optional[str] = None
+    attn_impl: str = "dense"
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        B, L = tokens.shape
+        x = nn.Embed(self.vocab_size, self.d_model, name="tok_embed")(tokens)
+        pos = _global_positions(L, self.seq_axis)
+        x = x + nn.Embed(self.max_seq_len, self.d_model, name="pos_embed")(pos)[None]
+        for i in range(self.num_layers):
+            x = MoETransformerBlock(
+                self.num_heads, self.d_model, self.d_ff, self.num_experts,
+                capacity_factor=self.capacity_factor, seq_axis=self.seq_axis,
+                attn_impl=self.attn_impl, name=f"block_{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(name="ln_final")(x)
+        return nn.Dense(self.vocab_size, name="lm_head")(x)
+
+
+def small_moe_lm(
+    vocab_size: int = 256,
+    num_layers: int = 2,
+    d_model: int = 64,
+    num_heads: int = 4,
+    d_ff: int = 128,
+    num_experts: int = 4,
+    max_seq_len: int = 64,
+    seq_len: int = 32,
+    seed: int = 0,
+    **kwargs,
+) -> Model:
+    module = MoETransformerLM(
+        vocab_size=vocab_size, num_layers=num_layers, d_model=d_model,
+        num_heads=num_heads, d_ff=d_ff, num_experts=num_experts,
+        max_seq_len=max_seq_len, **kwargs,
+    )
+    return Model.build(module, jnp.zeros((1, seq_len), jnp.int32), seed=seed)

@@ -1,0 +1,80 @@
+"""Pure-GSPMD training: no shard_map, just sharding annotations + jit.
+
+The "let XLA do it" engine: params are laid out by PartitionSpec rules (tensor
+and/or expert axes), the batch is sharded over ``data``, and GSPMD inserts every
+collective — gradient all-reduces, TP all-gathers, MoE all-to-alls. This is the
+idiomatic path when no *algorithmic* cross-replica structure (async folds,
+pipeline schedules) is needed — for those, use the shard_map engines.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.ops.losses import get_loss
+from distkeras_tpu.ops.optimizers import get_optimizer
+from distkeras_tpu.parallel.sharding import param_shardings
+from distkeras_tpu.runtime.mesh import DATA_AXIS
+
+
+class GSPMDState(NamedTuple):
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+
+
+class GSPMDEngine:
+    def __init__(
+        self,
+        model,
+        optimizer,
+        loss,
+        mesh: Mesh,
+        rules: Sequence = (),
+        learning_rate: float = 0.01,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.mesh = mesh
+        self.rules = rules
+        self.tx = get_optimizer(optimizer, learning_rate)
+        self.loss_fn = get_loss(loss)
+        self.seed = seed
+        module = model.module
+        loss_fn = self.loss_fn
+        tx = self.tx
+
+        def step(state: GSPMDState, x, y):
+            def loss_of(p, rng):
+                out = module.apply({"params": p}, x, train=True,
+                                   rngs={"dropout": rng})
+                return loss_fn(out.astype(jnp.float32), y)
+
+            rng, sub = jax.random.split(state.rng)
+            loss, grads = jax.value_and_grad(loss_of)(state.params, sub)
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            return GSPMDState(params, opt_state, rng), loss
+
+        self._step = jax.jit(step, donate_argnums=(0,))
+
+    def init_state(self) -> GSPMDState:
+        params = jax.tree.map(lambda a: np.array(a), self.model.params)
+        shardings = param_shardings(params, self.mesh, self.rules)
+        params = jax.device_put(params, shardings)
+        opt_state = jax.jit(self.tx.init)(params)
+        rng = jax.device_put(jax.random.key(self.seed),
+                             NamedSharding(self.mesh, P()))
+        return GSPMDState(params, opt_state, rng)
+
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(DATA_AXIS))
+
+    def step(self, state: GSPMDState, x, y):
+        return self._step(state, x, y)

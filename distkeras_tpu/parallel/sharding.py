@@ -15,7 +15,7 @@ from typing import Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distkeras_tpu.runtime.mesh import MODEL_AXIS
+from distkeras_tpu.runtime.mesh import EXPERT_AXIS, MODEL_AXIS
 
 # (path regex, spec). Paths are '/'-joined flax param paths, e.g.
 # "block_0/attn/query/kernel".
@@ -31,6 +31,16 @@ TRANSFORMER_TP_RULES: list[tuple[str, P]] = [
     (r"lm_head/kernel$", P(None, MODEL_AXIS)),
     (r"lm_head/bias$", P(MODEL_AXIS)),
 ]
+
+# Mixture-of-Experts: the stacked expert bank's leading axis is the expert id —
+# shard it over the ``expert`` mesh axis (GSPMD turns the dispatch/combine
+# einsums into all-to-alls). Router stays replicated.
+MOE_RULES: list[tuple[str, P]] = [
+    (r".*/moe/experts/up/kernel$", P(EXPERT_AXIS, None, None)),
+    (r".*/moe/experts/up/bias$", P(EXPERT_AXIS, None)),
+    (r".*/moe/experts/down/kernel$", P(EXPERT_AXIS, None, None)),
+    (r".*/moe/experts/down/bias$", P(EXPERT_AXIS, None)),
+] + TRANSFORMER_TP_RULES
 
 
 def param_path_specs(params, rules: Sequence[tuple[str, P]]):
@@ -53,7 +63,24 @@ def param_path_specs(params, rules: Sequence[tuple[str, P]]):
 
 
 def param_shardings(params, mesh: Mesh, rules: Sequence[tuple[str, P]]):
-    """Pytree of NamedShardings for ``params`` on ``mesh`` under ``rules``."""
+    """Pytree of NamedShardings for ``params`` on ``mesh`` under ``rules``.
+
+    Spec axes not present in ``mesh`` degrade to replicated, so one rule set
+    (e.g. MOE_RULES, which mentions both ``expert`` and ``model``) serves every
+    mesh shape.
+    """
     specs = param_path_specs(params, rules)
-    return jax.tree.map(lambda s: NamedSharding(mesh, s),
+
+    def restrict(spec: P) -> P:
+        def keep(axis):
+            if axis is None:
+                return None
+            if isinstance(axis, (tuple, list)):
+                kept = tuple(a for a in axis if a in mesh.axis_names)
+                return kept if kept else None
+            return axis if axis in mesh.axis_names else None
+
+        return P(*(keep(a) for a in spec))
+
+    return jax.tree.map(lambda s: NamedSharding(mesh, restrict(s)),
                         specs, is_leaf=lambda x: isinstance(x, P))
