@@ -1,0 +1,118 @@
+"""Keras-3 model ingestion — the north star's "swap the Keras backend to jax".
+
+The reference's users hand a compiled Keras model to ``Trainer(model, ...)``
+(``distkeras/trainers.py``). Here :func:`from_keras` wraps any Keras-3 model (built
+on the JAX backend) in our :class:`~distkeras_tpu.models.base.Model` surface, so the
+same notebooks can keep their Keras ``Sequential``/functional definitions and train
+them under every discipline engine: the adapter duck-types the flax-module protocol
+the engines use (``apply({'params': ...}, x, train=..., rngs=...)``) on top of
+``keras.Model.stateless_call`` — which on the JAX backend is a pure function and
+therefore jit/shard_map/grad-safe.
+
+Restrictions (asserted at ingestion): the model must have no *updating*
+non-trainable state (BatchNorm running stats, seed generators). Frozen
+non-trainable variables are fine — they ride along as captured constants. That
+covers the reference's 2016-era workloads (Dense/Conv/LSTM stacks).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+# Must win over ~/.keras/keras.json before anything imports keras.
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+from distkeras_tpu.models.base import Model
+from distkeras_tpu.runtime.serialization import register_model_class
+
+
+def _keras():
+    os.environ.setdefault("KERAS_BACKEND", "jax")
+    import keras
+
+    if keras.backend.backend() != "jax":
+        raise RuntimeError(
+            "keras must run on the jax backend (set KERAS_BACKEND=jax before "
+            f"importing keras; current: {keras.backend.backend()!r})"
+        )
+    return keras
+
+
+class KerasModuleAdapter:
+    """flax-module duck type over a Keras-3 model (JAX backend)."""
+
+    def __init__(self, keras_model, non_trainable: list):
+        self.keras_model = keras_model
+        self.non_trainable = non_trainable
+
+    def apply(self, variables, *inputs, train: bool = False, rngs=None, **kw):
+        # rngs ignored: Keras manages dropout seeds via its own seed variables;
+        # models with *stateful* seeds are rejected at ingestion.
+        params = variables["params"]
+        out, _ = self.keras_model.stateless_call(
+            params, self.non_trainable, *inputs, training=train
+        )
+        return out
+
+    # -- config round-trip for serialize_model -----------------------------
+    def get_config(self) -> dict[str, Any]:
+        return {
+            "model_json": self.keras_model.to_json(),
+            "non_trainable": [np.asarray(v).tolist() for v in self.non_trainable],
+        }
+
+    @classmethod
+    def from_config(cls, kwargs: dict[str, Any]) -> "KerasModuleAdapter":
+        keras = _keras()
+        model = keras.models.model_from_json(kwargs["model_json"])
+        nt = [np.asarray(v, np.float32) for v in kwargs["non_trainable"]]
+        return cls(model, nt)
+
+    @staticmethod
+    def fix_params_structure(params):
+        """msgpack restores the trainable-variable list as a str-keyed dict."""
+        if isinstance(params, dict):
+            return [params[k] for k in sorted(params, key=int)]
+        return params
+
+
+register_model_class("KerasModuleAdapter", KerasModuleAdapter)
+
+
+def from_keras(keras_model, sample_input=None) -> Model:
+    """Wrap a Keras-3 model as a distkeras_tpu :class:`Model`.
+
+    ``sample_input`` builds the model if it isn't built yet (any array with the
+    right trailing dims).
+    """
+    _keras()
+    if not keras_model.built:
+        if sample_input is None:
+            raise ValueError("model is unbuilt; pass sample_input to build it")
+        keras_model(np.asarray(sample_input))
+
+    trainable = [jax.numpy.asarray(v.value) for v in keras_model.trainable_variables]
+    non_trainable = [
+        jax.numpy.asarray(v.value) for v in keras_model.non_trainable_variables
+    ]
+    # Reject models whose forward pass mutates non-trainable state: our engines
+    # carry only `params`, so silent staleness would result.
+    if non_trainable and sample_input is not None:
+        _, nt_after = keras_model.stateless_call(
+            trainable, non_trainable, np.asarray(sample_input), training=True
+        )
+        for before, after in zip(non_trainable, nt_after):
+            if before.shape != np.shape(after) or not np.allclose(
+                np.asarray(before), np.asarray(after)
+            ):
+                raise ValueError(
+                    "model updates non-trainable state in training mode (e.g. "
+                    "BatchNorm running stats / stateful seeds); not supported — "
+                    "use GroupNorm/LayerNorm variants"
+                )
+    module = KerasModuleAdapter(keras_model, non_trainable)
+    return Model(module=module, params=trainable)

@@ -75,4 +75,8 @@ def deserialize_model(data: bytes):
     cls = get_model_class(spec["class"])
     module = cls.from_config(spec["kwargs"])
     params = flax_ser.msgpack_restore(data[off:])
+    # msgpack round-trips lists as {'0': ..., '1': ...} dicts; modules that use
+    # list-shaped params (e.g. the Keras adapter) restore the structure here.
+    if hasattr(module, "fix_params_structure"):
+        params = module.fix_params_structure(params)
     return Model(module=module, params=params)
