@@ -55,9 +55,15 @@ class BatchPlan:
         return self.num_workers * self.window * self.batch_size
 
     def round(self, r: int) -> tuple[np.ndarray, np.ndarray]:
-        """Materialize round ``r``: ``[W, K, B, ...]`` feature + label arrays."""
+        """Materialize round ``r``: ``[W, K, B, ...]`` feature + label arrays.
+
+        Uses the native threaded gather (``data/native_loader.py``) when built;
+        falls back to numpy fancy indexing bit-identically.
+        """
+        from distkeras_tpu.data.native_loader import gather_rows
+
         idx = self.index[r]
-        return self.x[idx], self.y[idx]
+        return gather_rows(self.x, idx), gather_rows(self.y, idx)
 
 
 def make_batches(

@@ -1,0 +1,49 @@
+"""Round prefetcher: overlap host-side gather + H2D transfer with device compute.
+
+The reference got pipelining for free from Spark's executor iterators; here a
+background thread materializes round ``r+depth`` (native gather) and stages it on
+device (``device_put``) while the accelerator crunches round ``r``. jax dispatch is
+async, so the main loop's only synchronous cost becomes a queue pop.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator
+
+
+class RoundFeeder:
+    """Iterate ``(r, staged_batch)`` over a BatchPlan with lookahead.
+
+    ``stage(r) -> batch`` does the gather + device_put for round ``r``; it runs on
+    the feeder thread. Exceptions propagate to the consumer on the next pop.
+    """
+
+    def __init__(self, num_rounds: int, stage: Callable[[int], object],
+                 start_round: int = 0, depth: int = 2):
+        self.num_rounds = num_rounds
+        self.stage = stage
+        self.start_round = start_round
+        self.depth = max(1, depth)
+        self._q: queue.Queue = queue.Queue(maxsize=self.depth)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        try:
+            for r in range(self.start_round, self.num_rounds):
+                self._q.put((r, self.stage(r), None))
+        except BaseException as e:  # noqa: BLE001 - propagate to consumer
+            self._q.put((-1, None, e))
+        else:
+            self._q.put((None, None, None))  # sentinel
+
+    def __iter__(self) -> Iterator:
+        self._thread.start()
+        while True:
+            r, batch, err = self._q.get()
+            if err is not None:
+                raise err
+            if r is None:
+                return
+            yield r, batch

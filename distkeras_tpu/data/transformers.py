@@ -86,11 +86,18 @@ class MinMaxTransformer(Transformer):
         self.output_col = output_col
 
     def transform(self, df: DataFrame) -> DataFrame:
-        x = np.asarray(df[self.input_col], np.float32)
+        from distkeras_tpu.data.native_loader import scale_f32
+
+        x = np.ascontiguousarray(df[self.input_col], np.float32)
         i_min = float(x.min()) if self.i_min is None else self.i_min
         i_max = float(x.max()) if self.i_max is None else self.i_max
         scale = (self.o_max - self.o_min) / max(i_max - i_min, 1e-12)
-        return df.with_column(self.output_col, (x - i_min) * scale + self.o_min)
+        if scale == 0.0:
+            out = np.full_like(x, self.o_min)
+        else:
+            # (x - i_min)*scale + o_min == (x - (i_min - o_min/scale)) * scale
+            out = scale_f32(x, i_min - self.o_min / scale, scale)
+        return df.with_column(self.output_col, out)
 
 
 class ReshapeTransformer(Transformer):

@@ -1,0 +1,80 @@
+// Native data-plane kernels: multithreaded row gather for batch planning.
+//
+// The reference delegates its data plane to Spark's JVM (partition shuffle and
+// per-executor iterators, SURVEY.md L1/external substrate); the TPU rebuild's
+// equivalent host-side hot path is materializing each fold round's
+// [workers, window, batch, ...] array from the index matrix
+// (distkeras_tpu/data/batching.py -> BatchPlan.round). numpy's fancy indexing
+// is single-threaded and holds the GIL; this gather releases it across a small
+// thread pool so the feed keeps up with the device and overlaps with dispatch.
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o _loader.so loader.cc -lpthread
+// (distkeras_tpu/data/native_loader.py does this on demand and caches the .so)
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Gather rows: out[i, :] = src[idx[i], :] for i in [0, n_idx).
+// row_bytes is the size of one row in bytes; src has n_rows rows.
+// Returns 0 on success, -1 on out-of-range index (out contents undefined).
+int dk_gather_rows(const uint8_t* src, int64_t n_rows, int64_t row_bytes,
+                   const int64_t* idx, int64_t n_idx, uint8_t* out,
+                   int num_threads) {
+  if (num_threads < 1) num_threads = 1;
+  std::atomic<int> bad{0};
+  auto worker = [&](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) {
+      const int64_t r = idx[i];
+      if (r < 0 || r >= n_rows) {
+        bad.store(1, std::memory_order_relaxed);
+        return;
+      }
+      std::memcpy(out + i * row_bytes, src + r * row_bytes,
+                  static_cast<size_t>(row_bytes));
+    }
+  };
+  if (num_threads == 1 || n_idx < 4 * num_threads) {
+    worker(0, n_idx);
+  } else {
+    std::vector<std::thread> threads;
+    const int64_t chunk = (n_idx + num_threads - 1) / num_threads;
+    for (int t = 0; t < num_threads; ++t) {
+      const int64_t begin = t * chunk;
+      const int64_t end = begin + chunk < n_idx ? begin + chunk : n_idx;
+      if (begin >= end) break;
+      threads.emplace_back(worker, begin, end);
+    }
+    for (auto& th : threads) th.join();
+  }
+  return bad.load() ? -1 : 0;
+}
+
+// Normalize float32 rows in place: out = (x - offset) * scale.
+// The MinMaxTransformer hot loop for large frames.
+void dk_scale_f32(const float* src, int64_t n, float offset, float scale,
+                  float* out, int num_threads) {
+  if (num_threads < 1) num_threads = 1;
+  auto worker = [&](int64_t begin, int64_t end) {
+    for (int64_t i = begin; i < end; ++i) out[i] = (src[i] - offset) * scale;
+  };
+  if (num_threads == 1 || n < 1 << 16) {
+    worker(0, n);
+  } else {
+    std::vector<std::thread> threads;
+    const int64_t chunk = (n + num_threads - 1) / num_threads;
+    for (int t = 0; t < num_threads; ++t) {
+      const int64_t begin = t * chunk;
+      const int64_t end = begin + chunk < n ? begin + chunk : n;
+      if (begin >= end) break;
+      threads.emplace_back(worker, begin, end);
+    }
+    for (auto& th : threads) th.join();
+  }
+}
+
+}  // extern "C"

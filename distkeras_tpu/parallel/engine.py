@@ -174,8 +174,12 @@ class AsyncEngine:
         if state is None:
             state = self.init_state()
         losses = []
-        for r in range(start_round, plan.num_rounds):
-            xs, ys = self._put_batch(*plan.round(r))
+        from distkeras_tpu.data.prefetch import RoundFeeder
+
+        feeder = RoundFeeder(plan.num_rounds,
+                             lambda r: self._put_batch(*plan.round(r)),
+                             start_round=start_round)
+        for r, (xs, ys) in feeder:
             new_state, loss = self._round_fn(state, xs, ys)
             losses.append(loss)
             if on_round is not None:

@@ -119,10 +119,14 @@ class SyncEngine:
             state = self.init_state()
         shard = NamedSharding(self.mesh, P(DATA_AXIS))
         losses = []
-        for r in range(start_round, plan.num_rounds):
+        from distkeras_tpu.data.prefetch import RoundFeeder
+
+        def stage(r):
             fx, fy = plan.round(r)
-            xs = jax.device_put(fx, shard)
-            ys = jax.device_put(fy, shard)
+            return jax.device_put(fx, shard), jax.device_put(fy, shard)
+
+        feeder = RoundFeeder(plan.num_rounds, stage, start_round=start_round)
+        for r, (xs, ys) in feeder:
             new_state, loss = self._round_fn(state, xs, ys)
             losses.append(loss)
             if on_round is not None:
