@@ -54,13 +54,16 @@ int dk_gather_rows(const uint8_t* src, int64_t n_rows, int64_t row_bytes,
   return bad.load() ? -1 : 0;
 }
 
-// Normalize float32 rows in place: out = (x - offset) * scale.
-// The MinMaxTransformer hot loop for large frames.
+// Normalize float32 rows: out = (x - offset) * scale + bias.
+// The MinMaxTransformer hot loop for large frames. bias is applied separately
+// (NOT folded into offset) to avoid catastrophic cancellation when scale is
+// huge (degenerate input ranges).
 void dk_scale_f32(const float* src, int64_t n, float offset, float scale,
-                  float* out, int num_threads) {
+                  float bias, float* out, int num_threads) {
   if (num_threads < 1) num_threads = 1;
   auto worker = [&](int64_t begin, int64_t end) {
-    for (int64_t i = begin; i < end; ++i) out[i] = (src[i] - offset) * scale;
+    for (int64_t i = begin; i < end; ++i)
+      out[i] = (src[i] - offset) * scale + bias;
   };
   if (num_threads == 1 || n < 1 << 16) {
     worker(0, n);
