@@ -25,6 +25,11 @@ _lib = None
 _lock = threading.Lock()
 _DISABLED = os.environ.get("DKTPU_NO_NATIVE", "") == "1"
 
+# Must match dk_abi_version() in native/loader.cc. Bump both on any signature
+# change; a mismatch (stale cached .so, or .cc edited without this constant)
+# disables the native path rather than calling through a wrong prototype.
+_ABI_VERSION = 2
+
 
 def _build() -> bool:
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _SO, _SRC,
@@ -53,6 +58,13 @@ def get_lib():
             lib = ctypes.CDLL(_SO)
         except OSError:
             return None
+        try:
+            lib.dk_abi_version.restype = ctypes.c_int
+            lib.dk_abi_version.argtypes = []
+            if lib.dk_abi_version() != _ABI_VERSION:
+                return None
+        except AttributeError:
+            return None  # pre-versioned .so: refuse it
         lib.dk_gather_rows.restype = ctypes.c_int
         lib.dk_gather_rows.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
@@ -61,7 +73,7 @@ def get_lib():
         lib.dk_scale_f32.restype = None
         lib.dk_scale_f32.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_float, ctypes.c_float,
-            ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_float, ctypes.c_void_p, ctypes.c_int,
         ]
         _lib = lib
         return _lib
@@ -96,15 +108,21 @@ def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
     return out.reshape(idx.shape + src.shape[1:])
 
 
-def scale_f32(src: np.ndarray, offset: float, scale: float) -> np.ndarray:
-    """``(src - offset) * scale`` for float32 arrays (threaded when native)."""
+def scale_f32(src: np.ndarray, offset: float, scale: float,
+              bias: float = 0.0) -> np.ndarray:
+    """``(src - offset) * scale + bias`` for float32 arrays (threaded when native).
+
+    ``bias`` is applied separately rather than folded into ``offset`` so that a
+    huge ``scale`` (degenerate input range) can't cancel it away in float32.
+    """
     lib = get_lib()
     if lib is None or src.dtype != np.float32 or not src.flags.c_contiguous:
-        return ((src - offset) * scale).astype(np.float32)
+        return (((src - np.float32(offset)) * np.float32(scale))
+                + np.float32(bias)).astype(np.float32)
     out = np.empty_like(src)
     lib.dk_scale_f32(
         src.ctypes.data_as(ctypes.c_void_p), src.size,
-        ctypes.c_float(offset), ctypes.c_float(scale),
+        ctypes.c_float(offset), ctypes.c_float(scale), ctypes.c_float(bias),
         out.ctypes.data_as(ctypes.c_void_p), num_threads(),
     )
     return out

@@ -95,8 +95,7 @@ class MinMaxTransformer(Transformer):
         if scale == 0.0:
             out = np.full_like(x, self.o_min)
         else:
-            # (x - i_min)*scale + o_min == (x - (i_min - o_min/scale)) * scale
-            out = scale_f32(x, i_min - self.o_min / scale, scale)
+            out = scale_f32(x, i_min, scale, bias=self.o_min)
         return df.with_column(self.output_col, out)
 
 

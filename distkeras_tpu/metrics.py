@@ -14,6 +14,7 @@ import time
 from typing import Optional
 
 import jax
+import numpy as np
 
 
 class MetricsLogger:
@@ -44,13 +45,16 @@ class MetricsLogger:
         now = time.perf_counter()
         dt = now - self._last_t
         self._last_t = now
+        loss = np.asarray(loss)
         rec = {
             "ts": time.time(),
             "round": round_idx,
-            "loss": float(loss),
+            "loss": float(loss.mean()),
             "round_seconds": round(dt, 6),
             **self.extra,
         }
+        if loss.size > 1:  # async engines report one loss per worker
+            rec["worker_loss"] = [round(float(v), 6) for v in loss.ravel()]
         if self.samples_per_round and dt > 0:
             rec["samples_per_sec"] = round(self.samples_per_round / dt, 2)
             rec["samples_per_sec_per_chip"] = round(

@@ -51,10 +51,17 @@ def register_model(cls: type) -> type:
 
 @dataclasses.dataclass
 class Model:
-    """(module, params) bundle with the serialization surface of a Keras model."""
+    """(module, params) bundle with the serialization surface of a Keras model.
+
+    ``sample_spec`` (shapes/dtypes of the build-time sample input) is retained so
+    replicas can be *re-initialized* with fresh PRNG keys — the reference got
+    per-executor re-init for free from ``uniform_weights`` + model deserialization
+    per worker; here :meth:`reinit_params` provides it functionally.
+    """
 
     module: nn.Module
     params: Any
+    sample_spec: Any = None
 
     @classmethod
     def build(
@@ -72,7 +79,9 @@ class Model:
         inputs = sample_input if isinstance(sample_input, tuple) else (sample_input,)
         variables = module.init(jax.random.key(seed), *inputs, train=False)
         params = variables["params"]
-        return cls(module=module, params=params)
+        spec = tuple(jax.ShapeDtypeStruct(jnp.shape(a), jnp.asarray(a).dtype)
+                     for a in inputs)
+        return cls(module=module, params=params, sample_spec=spec)
 
     def apply(self, params, *inputs, train: bool = False, rng=None):
         """Pure forward pass — the jit-safe core of ``model.predict``/``train_on_batch``."""
@@ -84,6 +93,29 @@ class Model:
 
     def with_params(self, params) -> "Model":
         return dataclasses.replace(self, params=params)
+
+    def reinit_params(self, seed: int):
+        """Fresh parameters drawn with a different PRNG key (ensemble diversity).
+
+        Models built via :meth:`build` re-trace the module's own initializers on
+        the recorded sample spec. Models without one (deserialized or
+        Keras-ingested) fall back to permuting each float leaf's elements — a
+        random permutation of an i.i.d. init draw is another draw from the same
+        empirical distribution, and constant-init leaves (biases) are fixed
+        points of it, matching a true re-init.
+        """
+        if self.sample_spec is not None:
+            inputs = tuple(jnp.zeros(s.shape, s.dtype) for s in self.sample_spec)
+            variables = self.module.init(jax.random.key(seed), *inputs, train=False)
+            return variables["params"]
+        leaves, treedef = jax.tree.flatten(self.params)
+        keys = jax.random.split(jax.random.key(seed), len(leaves))
+        new = [
+            jax.random.permutation(k, jnp.ravel(x)).reshape(jnp.shape(x))
+            if jnp.issubdtype(x.dtype, jnp.floating) and x.size > 1 else x
+            for k, x in zip(keys, leaves)
+        ]
+        return jax.tree.unflatten(treedef, new)
 
     def spec(self) -> dict[str, Any]:
         return {"class": type(self.module).__name__, "kwargs": self.module.get_config()}

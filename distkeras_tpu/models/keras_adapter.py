@@ -13,6 +13,13 @@ Restrictions (asserted at ingestion): the model must have no *updating*
 non-trainable state (BatchNorm running stats, seed generators). Frozen
 non-trainable variables are fine — they ride along as captured constants. That
 covers the reference's 2016-era workloads (Dense/Conv/LSTM stacks).
+
+BatchNorm story: pass ``batchnorm="freeze"`` to ingest BatchNorm-bearing models.
+Freezing puts every BatchNormalization layer in inference mode (Keras semantics
+of ``layer.trainable = False``): it normalizes by its stored moving statistics,
+which ride along as frozen constants. This is the standard fine-tuning treatment
+and the *deterministic* choice for data-parallel training — per-replica running
+stats would otherwise diverge across workers and need their own collective.
 """
 
 from __future__ import annotations
@@ -83,17 +90,36 @@ class KerasModuleAdapter:
 register_model_class("KerasModuleAdapter", KerasModuleAdapter)
 
 
-def from_keras(keras_model, sample_input=None) -> Model:
+def _iter_layers(layer):
+    yield layer
+    for sub in getattr(layer, "layers", []) or []:
+        yield from _iter_layers(sub)
+
+
+def from_keras(keras_model, sample_input=None, batchnorm: str = "error") -> Model:
     """Wrap a Keras-3 model as a distkeras_tpu :class:`Model`.
 
     ``sample_input`` builds the model if it isn't built yet (any array with the
     right trailing dims).
+
+    ``batchnorm``: ``"error"`` (default) rejects models whose forward pass
+    updates non-trainable state; ``"freeze"`` sets every BatchNormalization
+    layer ``trainable = False`` first — Keras then runs it in inference mode
+    (moving statistics used, never updated), making the model pure and
+    ingestable. See the module docstring for why freezing is the right
+    data-parallel semantics.
     """
-    _keras()
+    keras = _keras()
+    if batchnorm not in ("error", "freeze"):
+        raise ValueError(f"batchnorm must be 'error' or 'freeze', got {batchnorm!r}")
     if not keras_model.built:
         if sample_input is None:
             raise ValueError("model is unbuilt; pass sample_input to build it")
         keras_model(np.asarray(sample_input))
+    if batchnorm == "freeze":
+        for layer in _iter_layers(keras_model):
+            if isinstance(layer, keras.layers.BatchNormalization):
+                layer.trainable = False
 
     trainable = [jax.numpy.asarray(v.value) for v in keras_model.trainable_variables]
     non_trainable = [
@@ -111,8 +137,10 @@ def from_keras(keras_model, sample_input=None) -> Model:
             ):
                 raise ValueError(
                     "model updates non-trainable state in training mode (e.g. "
-                    "BatchNorm running stats / stateful seeds); not supported — "
-                    "use GroupNorm/LayerNorm variants"
+                    "BatchNorm running stats / stateful seeds). For BatchNorm "
+                    "models pass from_keras(..., batchnorm='freeze') to run BN "
+                    "in inference mode; otherwise use GroupNorm/LayerNorm "
+                    "variants"
                 )
     module = KerasModuleAdapter(keras_model, non_trainable)
     return Model(module=module, params=trainable)

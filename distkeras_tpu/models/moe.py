@@ -67,6 +67,8 @@ class MoEMLP(nn.Module):
         frac = onehot.mean(axis=0)
         prob_mass = probs.mean(axis=0)
         self.sow("intermediates", "aux_loss", E * jnp.sum(frac * prob_mass))
+        # Per-expert token fractions, for balance observability/tests.
+        self.sow("intermediates", "expert_fraction", frac)
 
         expert_in = jnp.einsum("tec,td->ecd", dispatch, xf.astype(jnp.float32))
         experts = nn.vmap(

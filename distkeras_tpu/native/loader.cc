@@ -19,6 +19,12 @@
 
 extern "C" {
 
+// ABI contract between this translation unit and the ctypes declarations in
+// distkeras_tpu/data/native_loader.py (_ABI_VERSION). Bump BOTH on any
+// signature change; the Python side refuses to load a mismatched .so and
+// falls back to numpy instead of calling through a stale prototype.
+int dk_abi_version() { return 2; }
+
 // Gather rows: out[i, :] = src[idx[i], :] for i in [0, n_idx).
 // row_bytes is the size of one row in bytes; src has n_rows rows.
 // Returns 0 on success, -1 on out-of-range index (out contents undefined).

@@ -14,11 +14,3 @@ try:  # jax >= 0.7 exports shard_map at top level
     shard_map = jax.shard_map
 except AttributeError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map  # type: ignore
-
-
-def psum_tree(tree, axis_name: str):
-    return jax.lax.psum(tree, axis_name)
-
-
-def pmean_tree(tree, axis_name: str):
-    return jax.lax.pmean(tree, axis_name)

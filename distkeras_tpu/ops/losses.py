@@ -58,3 +58,24 @@ def get_loss(loss: Union[str, LossFn]) -> LossFn:
         return _LOSSES[loss]
     except KeyError:
         raise KeyError(f"unknown loss {loss!r}; known: {sorted(_LOSSES)}") from None
+
+
+def collect_aux_loss(mutated_variables) -> jnp.ndarray:
+    """Mean of every ``aux_loss`` value sown under ``intermediates``.
+
+    Models that carry auxiliary objectives (the MoE router's Switch
+    load-balancing loss, ``models/moe.py``) sow them per layer; engines with
+    ``aux_loss_weight > 0`` apply this against the mutated-variable dict that
+    ``module.apply(..., mutable=["intermediates"])`` returns. Returns 0 when
+    nothing was sown, so it is safe for aux-free models.
+    """
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(
+        mutated_variables.get("intermediates", {}))[0]
+    vals = [jnp.asarray(leaf, jnp.float32).mean()
+            for path, leaf in flat
+            if any(str(getattr(p, "key", p)) == "aux_loss" for p in path)]
+    if not vals:
+        return jnp.zeros((), jnp.float32)
+    return jnp.mean(jnp.stack(vals))

@@ -104,19 +104,29 @@ class DynSGDFold(Discipline):
     commit scaled by ``1/(staleness+1)``, staleness = number of center updates between
     the worker's pull and its commit.
 
-    Deterministic schedule: commits serialize in worker order within a round, so
-    worker ``i`` has staleness ``i`` — exactly the reference's counter semantics
-    (server update-counter minus the worker's last-pull counter) under the serialized
-    ordering.
+    Deterministic schedule: commits serialize within a round, so the committing
+    worker's staleness equals its position in the serialized order — exactly the
+    reference's counter semantics (server update-counter minus the worker's
+    last-pull counter) under the serialized ordering. The order **rotates by one
+    each round** (worker ``i``'s staleness at round ``r`` is ``(i + r) mod W``):
+    the reference's nondeterministic race gave every worker the same staleness
+    distribution *in expectation*, and a fixed order would instead permanently
+    weight worker 0's data shard at 1.0 and worker W-1's at 1/W. The rotation
+    keeps the schedule reproducible while equalizing per-shard effective weight
+    over any W consecutive rounds. ``fold_state`` carries the round counter.
     """
 
+    def init_state(self, params):
+        return jnp.zeros((), jnp.int32)
+
     def fold(self, center, local, fold_state, *, axis_name, window, num_workers):
-        staleness = lax.axis_index(axis_name).astype(jnp.float32)
+        worker = lax.axis_index(axis_name)
+        staleness = ((worker + fold_state) % num_workers).astype(jnp.float32)
         scale = 1.0 / (staleness + 1.0)
         delta = _tree_scale(_tree_sub(local, center), scale)
         total = lax.psum(delta, axis_name)
         new_center = _tree_add(center, total)
-        return FoldResult(new_center, new_center, fold_state)
+        return FoldResult(new_center, new_center, fold_state + 1)
 
 
 class AEASGDFold(Discipline):

@@ -65,6 +65,7 @@ class AsyncEngine:
         learning_rate: float = 0.01,
         compute_dtype=None,
         seed: int = 0,
+        per_worker_init: bool = False,
     ):
         self.model = model
         self.mesh = mesh
@@ -72,6 +73,7 @@ class AsyncEngine:
         self.window = window
         self.num_workers = mesh.shape[DATA_AXIS]
         self.seed = seed
+        self.per_worker_init = per_worker_init
         self.tx = get_optimizer(optimizer, learning_rate)
         self.loss_fn = get_loss(loss)
         self._local_loop = make_local_loop(
@@ -100,7 +102,11 @@ class AsyncEngine:
                 center, new_local, fold_state,
                 axis_name=DATA_AXIS, window=window, num_workers=num_workers,
             )
-            loss = jax.lax.pmean(jnp.mean(losses), DATA_AXIS)
+            # Per-worker window-mean loss, gathered on the worker axis: the
+            # global result is [W] — the per-worker training histories the
+            # reference optionally collected on the driver (SURVEY.md §5
+            # metrics row). The global loss is their mean (equal batch sizes).
+            loss = jnp.mean(losses)[None]
             next_rng = jax.random.split(rng, 1)[0]
             return (
                 new_center,
@@ -115,7 +121,7 @@ class AsyncEngine:
             body,
             mesh=self.mesh,
             in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),
+            out_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS)),
             check_vma=False,
         )
 
@@ -134,7 +140,15 @@ class AsyncEngine:
         # Deep-copy: round_fn donates its input state, and device_put may alias the
         # model's own buffers — donation must never delete the user's Model.
         center = jax.tree.map(lambda a: np.array(a), self.model.params)
-        locals_ = _stack_for_workers(center, W)
+        if self.per_worker_init:
+            # Ensemble/averaging semantics: each replica starts from its OWN init
+            # draw (reference: per-executor deserialization + uniform_weights),
+            # not a broadcast of the driver's — init diversity is the point.
+            per = [self.model.reinit_params(self.seed * 1009 + 1 + i)
+                   for i in range(W)]
+            locals_ = jax.tree.map(lambda *xs: jnp.stack(xs), *per)
+        else:
+            locals_ = _stack_for_workers(center, W)
         opt_state = _stack_for_workers(self.tx.init(center), W)
         fold_state = self.discipline.init_state(center)
         rng = jax.random.key(self.seed)
@@ -162,10 +176,12 @@ class AsyncEngine:
     ):
         """Execute fold rounds ``start_round..num_rounds`` (resume-aware).
 
-        Returns (state, losses). ``on_round(r, loss, state)`` fires after each round
-        — note ``state`` buffers are donated into the *next* round, so callbacks
-        that persist state must finish reading it before returning (the
-        Checkpointer saves with ``wait=True`` for exactly this reason).
+        Returns (state, losses) with ``losses`` shaped ``[rounds, W]`` — one
+        loss curve per worker (reference parity: per-worker Keras history).
+        ``on_round(r, loss, state)`` fires after each round — note ``state``
+        buffers are donated into the *next* round, so callbacks that persist
+        state must finish reading it before returning (the Checkpointer saves
+        with ``wait=True`` for exactly this reason).
         """
         if plan.num_workers != self.num_workers:
             raise ValueError(
@@ -185,4 +201,4 @@ class AsyncEngine:
             if on_round is not None:
                 on_round(r, loss, new_state)
             state = new_state
-        return state, np.asarray([float(l) for l in losses])
+        return state, np.asarray([np.asarray(l) for l in losses])

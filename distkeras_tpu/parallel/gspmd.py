@@ -17,7 +17,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distkeras_tpu.ops.losses import get_loss
+from distkeras_tpu.ops.losses import collect_aux_loss, get_loss
 from distkeras_tpu.ops.optimizers import get_optimizer
 from distkeras_tpu.parallel.sharding import param_shardings
 from distkeras_tpu.runtime.mesh import DATA_AXIS
@@ -39,6 +39,7 @@ class GSPMDEngine:
         rules: Sequence = (),
         learning_rate: float = 0.01,
         seed: int = 0,
+        aux_loss_weight: float = 0.0,
     ):
         self.model = model
         self.mesh = mesh
@@ -46,12 +47,23 @@ class GSPMDEngine:
         self.tx = get_optimizer(optimizer, learning_rate)
         self.loss_fn = get_loss(loss)
         self.seed = seed
+        self.aux_loss_weight = float(aux_loss_weight)
         module = model.module
         loss_fn = self.loss_fn
         tx = self.tx
+        aux_w = self.aux_loss_weight
 
         def step(state: GSPMDState, x, y):
             def loss_of(p, rng):
+                if aux_w:
+                    # Collect sown intermediates (MoE router load-balancing
+                    # loss) and add them to the task loss.
+                    out, mut = module.apply(
+                        {"params": p}, x, train=True, rngs={"dropout": rng},
+                        mutable=["intermediates"],
+                    )
+                    return (loss_fn(out.astype(jnp.float32), y)
+                            + aux_w * collect_aux_loss(mut))
                 out = module.apply({"params": p}, x, train=True,
                                    rngs={"dropout": rng})
                 return loss_fn(out.astype(jnp.float32), y)

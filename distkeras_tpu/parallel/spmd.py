@@ -74,6 +74,7 @@ class SPMDEngine:
         tp_rules,
         learning_rate: float = 0.01,
         seed: int = 0,
+        aux_loss_weight: float = 0.0,
     ):
         self.model = model
         self.mesh = mesh
@@ -81,6 +82,7 @@ class SPMDEngine:
         self.loss_fn = get_loss(loss)
         self.tp_rules = tp_rules
         self.seed = seed
+        self.aux_loss_weight = float(aux_loss_weight)
         self.manual_axes = frozenset(
             a for a in (DATA_AXIS, SEQ_AXIS) if mesh.shape.get(a, 1) >= 1
         )
@@ -91,6 +93,7 @@ class SPMDEngine:
         loss_fn = self.loss_fn
         tx = self.tx
         manual = self.manual_axes
+        aux_w = self.aux_loss_weight
 
         def body(params, opt_state, rng, tokens, targets):
             step_rng = jax.random.fold_in(
@@ -99,6 +102,15 @@ class SPMDEngine:
             )
 
             def loss_of(p):
+                if aux_w:
+                    logits, mut = module.apply(
+                        {"params": p}, tokens, train=True,
+                        rngs={"dropout": step_rng}, mutable=["intermediates"],
+                    )
+                    from distkeras_tpu.ops.losses import collect_aux_loss
+
+                    return (loss_fn(logits.astype(jnp.float32), targets)
+                            + aux_w * collect_aux_loss(mut))
                 logits = module.apply(
                     {"params": p}, tokens, train=True, rngs={"dropout": step_rng}
                 )

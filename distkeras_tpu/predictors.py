@@ -57,8 +57,14 @@ class ModelPredictor(Predictor):
         self._params = jax.device_put(self.model.params, rep)
         self._shard = NamedSharding(self.mesh, P(DATA_AXIS))
 
-    def predict(self, dataframe: DataFrame) -> DataFrame:
-        x = np.asarray(dataframe[self.features_col])
+    def _postprocess(self, out: np.ndarray) -> np.ndarray:
+        """Row-wise output transform hook (identity here; softmax/argmax in
+        subclasses). Row-wise so batch and streaming paths agree exactly."""
+        return out
+
+    def _predict_array(self, x: np.ndarray) -> np.ndarray:
+        """Model outputs for an arbitrary-length feature array, in fixed-shape
+        padded chunks (every chunk hits the same compiled executable)."""
         n = len(x)
         outs = []
         for start in range(0, n, self.chunk_size):
@@ -69,22 +75,103 @@ class ModelPredictor(Predictor):
             xb = jax.device_put(jnp.asarray(chunk), self._shard)
             out = np.asarray(self._fwd(self._params, xb))
             outs.append(out[: len(out) - pad] if pad else out)
-        return dataframe.with_column(self.output_col, np.concatenate(outs, axis=0))
+        return self._postprocess(np.concatenate(outs, axis=0))
+
+    def predict(self, dataframe: DataFrame) -> DataFrame:
+        x = np.asarray(dataframe[self.features_col])
+        return dataframe.with_column(self.output_col, self._predict_array(x))
 
 
 class ProbabilityPredictor(ModelPredictor):
     """Like ModelPredictor but appends softmax probabilities."""
 
-    def predict(self, dataframe: DataFrame) -> DataFrame:
-        df = super().predict(dataframe)
-        probs = jax.nn.softmax(jnp.asarray(df[self.output_col]), axis=-1)
-        return df.with_column(self.output_col, np.asarray(probs))
+    def _postprocess(self, out: np.ndarray) -> np.ndarray:
+        return np.asarray(jax.nn.softmax(jnp.asarray(out), axis=-1))
 
 
 class ClassPredictor(ModelPredictor):
     """Appends the argmax class index (the notebooks' common final step)."""
 
-    def predict(self, dataframe: DataFrame) -> DataFrame:
-        df = super().predict(dataframe)
-        cls = np.asarray(df[self.output_col]).argmax(axis=-1).astype(np.int32)
-        return df.with_column(self.output_col, cls)
+    def _postprocess(self, out: np.ndarray) -> np.ndarray:
+        return out.argmax(axis=-1).astype(np.int32)
+
+
+class StreamingPredictor(ModelPredictor):
+    """Continuous inference over an unbounded record stream.
+
+    Parity: the reference ships a Kafka streaming-inference example (SURVEY.md
+    §2 examples row — producer pushes feature records onto a topic, a consumer
+    maps ``model.predict`` over microbatches and re-emits them with
+    predictions). The TPU-native equivalent takes any iterator of feature
+    microbatches — a generator over a socket, a queue drained by a consumer
+    thread, a file tail — and yields one prediction array per input
+    microbatch, in order.
+
+    Records accumulate into ``chunk_size`` rows before a forward pass runs, so
+    arbitrary producer batch sizes still hit one compiled fixed-shape
+    executable; the final partial chunk is padded and flushed when the source
+    ends. ``postprocess`` follows the subclass hook, so
+    ``StreamingClassPredictor`` below emits class ids exactly like
+    :class:`ClassPredictor` does for dataframes.
+    """
+
+    def predict_stream(self, source):
+        """Yield ``predictions`` (one array per source microbatch, in order).
+
+        ``source`` yields feature arrays shaped ``[n, ...]`` (n may vary per
+        item; wrap single records as length-1 batches).
+        """
+        from collections import deque
+
+        sizes: deque[int] = deque()  # rows per emitted-pending microbatch
+        pending: list[np.ndarray] = []  # rows awaiting a forward pass
+        ready: list[np.ndarray] = []  # predicted rows, FIFO
+
+        def pending_rows() -> int:
+            return sum(len(r) for r in pending)
+
+        def compute(flush: bool) -> None:
+            x = np.concatenate(pending, axis=0) if pending else None
+            if x is None or not len(x):
+                return
+            take = (len(x) // self.chunk_size) * self.chunk_size
+            if flush:
+                take = len(x)  # pad out the final partial chunk
+            if take == 0:
+                return
+            ready.append(self._predict_array(x[:take]))
+            pending.clear()
+            if take < len(x):
+                pending.append(x[take:])
+
+        def drain():
+            while sizes:
+                need = sizes[0]
+                if sum(len(r) for r in ready) < need:
+                    return
+                parts = []
+                while need:
+                    r = ready[0]
+                    if len(r) <= need:
+                        parts.append(ready.pop(0))
+                        need -= len(parts[-1])
+                    else:
+                        parts.append(r[:need])
+                        ready[0] = r[need:]
+                        need = 0
+                sizes.popleft()
+                yield np.concatenate(parts, axis=0)
+
+        for microbatch in source:
+            mb = np.asarray(microbatch)
+            sizes.append(len(mb))
+            pending.append(mb)
+            if pending_rows() >= self.chunk_size:
+                compute(flush=False)
+            yield from drain()
+        compute(flush=True)
+        yield from drain()
+
+
+class StreamingClassPredictor(StreamingPredictor, ClassPredictor):
+    """Streaming inference emitting argmax class ids."""

@@ -2,9 +2,9 @@
 
 The reference keeps every hyperparameter as a trainer ``__init__`` kwarg
 (``distkeras/trainers.py``: ``num_workers``, ``batch_size``, ``num_epoch``,
-``communication_window``, ``learning_rate``, ``master_port``...). We keep that
-kwargs-first surface on the trainers and normalize into this dataclass internally, so
-jitted code sees one hashable config object.
+``communication_window``, ``learning_rate``, ``master_port``...). The trainers keep
+that kwargs-first surface and normalize into this frozen dataclass
+(``Trainer.config``); the kwarg names remain live as properties delegating here.
 """
 
 from __future__ import annotations
@@ -14,7 +14,8 @@ from typing import Optional
 
 import jax.numpy as jnp
 
-_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
+_DTYPES = {None: None, "float32": jnp.float32, "bfloat16": jnp.bfloat16,
+           "float16": jnp.float16}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -24,7 +25,7 @@ class RunConfig:
     communication_window: int = 5
     learning_rate: float = 0.01
     num_workers: Optional[int] = None  # None -> all devices
-    compute_dtype: str = "bfloat16"  # MXU-native; params stay float32
+    compute_dtype: Optional[str] = None  # "bfloat16" is MXU-native; params stay f32
     seed: int = 0
     shuffle: bool = False
     drop_remainder: bool = True

@@ -19,6 +19,7 @@ Trainer -> engine mapping:
 from __future__ import annotations
 
 import time
+import warnings
 from typing import Optional, Union
 
 import jax
@@ -39,17 +40,42 @@ from distkeras_tpu.parallel.disciplines import (
 )
 from distkeras_tpu.parallel.engine import AsyncEngine
 from distkeras_tpu.parallel.sync import SyncEngine
+from distkeras_tpu.runtime.config import RunConfig
 from distkeras_tpu.runtime.mesh import data_mesh
 
-_DTYPES = {None: None, "float32": jnp.float32, "bfloat16": jnp.bfloat16}
+#: Socket-era reference kwargs that have no TPU meaning: the parameter-server
+#: transport is XLA collectives, so there is no master address/port to bind.
+#: Accepted-and-ignored (with a warning) so 2016-era notebooks port by deleting
+#: imports, not by editing every constructor call.
+_LEGACY_SOCKET_KWARGS = frozenset({"master_port", "master_host", "master", "port"})
+
+
+def _config_prop(name: str) -> property:
+    """Trainer attribute backed by the :class:`RunConfig` (kwargs-first surface
+    preserved; assignment rebuilds the frozen config)."""
+
+    def _get(self):
+        return getattr(self.config, name)
+
+    def _set(self, value):
+        self.config = self.config.replace(**{name: value})
+
+    return property(_get, _set)
 
 
 class Trainer:
     """Base trainer (reference ``Trainer``): owns model, optimizer, loss, timing.
 
     ``worker_optimizer`` and ``loss`` accept the reference's Keras-style strings or
-    any optax transformation / callable.
+    any optax transformation / callable. Hyperparameters normalize into
+    ``self.config`` (:class:`RunConfig`); the reference's kwarg names stay
+    readable/assignable as properties over it.
     """
+
+    batch_size = _config_prop("batch_size")
+    num_epoch = _config_prop("num_epoch")
+    learning_rate = _config_prop("learning_rate")
+    seed = _config_prop("seed")
 
     def __init__(
         self,
@@ -67,24 +93,56 @@ class Trainer:
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 0,
         resume: bool = False,
+        **kwargs,
     ):
+        legacy = {k: kwargs.pop(k) for k in list(kwargs) if k in _LEGACY_SOCKET_KWARGS}
+        if kwargs:
+            raise TypeError(
+                f"{type(self).__name__} got unexpected kwargs: {sorted(kwargs)}"
+            )
+        if legacy:
+            warnings.warn(
+                f"ignoring socket-era kwargs {sorted(legacy)}: the parameter "
+                "server is an XLA collective fold on TPU — there is no master "
+                "address/port (kept for reference-notebook compatibility)",
+                DeprecationWarning,
+                stacklevel=2,
+            )
         self.model = model
         self.worker_optimizer = worker_optimizer
         self.loss = loss
         self.features_col = features_col
         self.label_col = label_col
-        self.batch_size = batch_size
-        self.num_epoch = num_epoch
-        self.learning_rate = learning_rate
-        self.compute_dtype = _DTYPES[compute_dtype] if isinstance(compute_dtype, (str, type(None))) else compute_dtype
-        self.seed = seed
+        if isinstance(compute_dtype, (str, type(None))):
+            dtype_str, self._dtype_override = compute_dtype, None
+        else:  # a concrete jnp dtype: bypasses the string-keyed config
+            dtype_str, self._dtype_override = None, compute_dtype
+        self.config = RunConfig(
+            batch_size=batch_size, num_epoch=num_epoch,
+            learning_rate=learning_rate, compute_dtype=dtype_str, seed=seed,
+        )
         self.metrics_path = metrics_path
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
         self.resume = resume
         self.history: np.ndarray | None = None
+        self.worker_histories: np.ndarray | None = None
         self.training_time: float = 0.0
         self._t_start: float | None = None
+
+    @property
+    def compute_dtype(self):
+        if self._dtype_override is not None:
+            return self._dtype_override
+        return self.config.dtype
+
+    @compute_dtype.setter
+    def compute_dtype(self, value):
+        if isinstance(value, (str, type(None))):
+            self._dtype_override = None
+            self.config = self.config.replace(compute_dtype=value)
+        else:
+            self._dtype_override = value
 
     def _execute(self, engine, plan):
         """Shared run harness: resume from checkpoint, per-round metrics/saves."""
@@ -125,7 +183,13 @@ class Trainer:
             ckpt.close()
         if logger is not None:
             logger.close()
-        self.history = losses
+        losses = np.asarray(losses)
+        if losses.ndim == 2:  # async engines: [rounds, W] per-worker curves
+            self.worker_histories = losses.T
+            self.history = losses.mean(axis=1)
+        else:
+            self.worker_histories = None
+            self.history = losses
         return state
 
     # -- timing parity (reference Trainer.record_training_start/stop) -------
@@ -140,6 +204,12 @@ class Trainer:
 
     def get_history(self) -> np.ndarray:
         return self.history
+
+    def get_worker_histories(self) -> Optional[np.ndarray]:
+        """Per-worker loss curves, shape ``[num_workers, rounds]`` (reference
+        parity: per-worker Keras history collected on the driver; SURVEY.md §5
+        metrics row). ``None`` for sync engines, whose replicas never diverge."""
+        return self.worker_histories
 
     def train(self, dataframe: DataFrame, shuffle: bool = False) -> Model:
         raise NotImplementedError
@@ -174,9 +244,11 @@ class SingleTrainer(Trainer):
 class DistributedTrainer(Trainer):
     """Base for multi-worker trainers (reference ``DistributedTrainer``)."""
 
+    num_workers = _config_prop("num_workers")
+
     def __init__(self, *args, num_workers: Optional[int] = None, **kwargs):
         super().__init__(*args, **kwargs)
-        self.num_workers = num_workers
+        self.config = self.config.replace(num_workers=num_workers)
 
     def _mesh(self):
         return data_mesh(num_workers=self.num_workers)
@@ -212,9 +284,11 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
     """Base for the discipline trainers (reference
     ``AsynchronousDistributedTrainer``): K local steps per worker per fold round."""
 
+    communication_window = _config_prop("communication_window")
+
     def __init__(self, *args, communication_window: int = 5, **kwargs):
         super().__init__(*args, **kwargs)
-        self.communication_window = communication_window
+        self.config = self.config.replace(communication_window=communication_window)
 
     def _discipline(self) -> Discipline:
         raise NotImplementedError
@@ -309,13 +383,21 @@ class AveragingTrainer(DistributedTrainer):
     ``AveragingTrainer``): the fold is a single ``pmean`` at the end, here computed
     from the stacked local replicas."""
 
+    communication_window = _config_prop("communication_window")
+
     def __init__(self, *args, communication_window: int = 8, **kwargs):
         super().__init__(*args, **kwargs)
-        self.communication_window = communication_window  # steps per program only
+        # steps per program only (no semantic effect: the fold is a no-op)
+        self.config = self.config.replace(communication_window=communication_window)
 
     def train(self, dataframe: DataFrame, shuffle: bool = False) -> Model:
         self.record_training_start()
         mesh = self._mesh()
+        # NOTE: replicas deliberately share one init (per_worker_init=False).
+        # Post-hoc *weight* averaging is only meaningful when all replicas
+        # descend within one loss basin; averaging independently-initialized
+        # nets produces a point between basins (verified: accuracy collapses).
+        # The reference likewise broadcast one serialized model to executors.
         engine = AsyncEngine(
             self.model, self.worker_optimizer, self.loss, EnsembleFold(), mesh,
             window=self.communication_window, learning_rate=self.learning_rate,
@@ -336,9 +418,11 @@ class EnsembleTrainer(DistributedTrainer):
     """Train N independent models, return all of them (reference
     ``EnsembleTrainer``)."""
 
+    communication_window = _config_prop("communication_window")
+
     def __init__(self, *args, communication_window: int = 8, **kwargs):
         super().__init__(*args, **kwargs)
-        self.communication_window = communication_window
+        self.config = self.config.replace(communication_window=communication_window)
 
     def train(self, dataframe: DataFrame, shuffle: bool = False) -> list[Model]:
         self.record_training_start()
@@ -346,7 +430,7 @@ class EnsembleTrainer(DistributedTrainer):
         engine = AsyncEngine(
             self.model, self.worker_optimizer, self.loss, EnsembleFold(), mesh,
             window=self.communication_window, learning_rate=self.learning_rate,
-            compute_dtype=self.compute_dtype, seed=self.seed,
+            compute_dtype=self.compute_dtype, seed=self.seed, per_worker_init=True,
         )
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,

@@ -1,0 +1,102 @@
+"""Streaming inference — the TPU-native port of the reference's Kafka example.
+
+The reference ships a Kafka streaming-inference pipeline (SURVEY.md §2, examples
+row): a producer pushes feature records onto a topic; a Spark consumer maps the
+trained model over each microbatch and re-emits records with predictions. Here
+the "topic" is a bounded queue fed by a producer thread and the consumer is
+:class:`~distkeras_tpu.predictors.StreamingPredictor.predict_stream`, which
+coalesces arbitrary microbatches into fixed-shape padded chunks so every forward
+pass hits one compiled executable.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/streaming_inference.py
+"""
+
+import argparse
+import queue
+import threading
+import time
+
+import numpy as np
+
+import distkeras_tpu as dk
+from distkeras_tpu.models.mlp import MLP
+from distkeras_tpu.predictors import StreamingClassPredictor
+
+
+def make_blobs(n, d=8, c=4, seed=0):
+    # Class centers are fixed across seeds; only the sample draw varies, so a
+    # model trained on seed 0 generalizes to the seed-1 stream.
+    centers = np.random.default_rng(42).normal(scale=4.0, size=(c, d))
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, c, size=n)
+    x = (centers[y] + rng.normal(scale=0.6, size=(n, d))).astype(np.float32)
+    return x, y.astype(np.int32)
+
+
+def producer(q, x, y, microbatch, delay_s):
+    """Simulates the Kafka producer: pushes (features, labels) microbatches."""
+    for start in range(0, len(x), microbatch):
+        q.put((x[start:start + microbatch], y[start:start + microbatch]))
+        time.sleep(delay_s)
+    q.put(None)  # end-of-stream marker
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--records", type=int, default=4096)
+    ap.add_argument("--microbatch", type=int, default=37)  # ragged on purpose
+    ap.add_argument("--chunk-size", type=int, default=512)
+    ap.add_argument("--delay-ms", type=float, default=1.0)
+    args = ap.parse_args()
+
+    # 1. Train a small classifier (stand-in for the reference's saved model).
+    x, y = make_blobs(args.records)
+    df = dk.DataFrame({"features": x, "label": y})
+    trainer = dk.SingleTrainer(
+        dk.Model.build(MLP(hidden=(32,), num_outputs=4),
+                       np.zeros((1, x.shape[1]), np.float32)),
+        worker_optimizer="adam", loss="sparse_categorical_crossentropy",
+        batch_size=64, num_epoch=3, learning_rate=0.01,
+    )
+    model = trainer.train(df, shuffle=True)
+
+    # 2. Producer thread feeds a bounded queue (the "topic").
+    q: queue.Queue = queue.Queue(maxsize=8)
+    sx, sy = make_blobs(args.records, seed=1)
+    t = threading.Thread(target=producer,
+                         args=(q, sx, sy, args.microbatch, args.delay_ms / 1e3),
+                         daemon=True)
+    t.start()
+
+    labels = []
+
+    def topic():
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            feats, labs = item
+            labels.append(labs)
+            yield feats
+
+    # 3. Consumer: predictions stream out one array per microbatch, in order.
+    predictor = StreamingClassPredictor(model, chunk_size=args.chunk_size)
+    n_seen = n_correct = 0
+    t0 = time.perf_counter()
+    for i, pred in enumerate(predictor.predict_stream(topic())):
+        n_seen += len(pred)
+        n_correct += int((pred == labels[i]).sum())
+        if (i + 1) % 20 == 0:
+            dt = time.perf_counter() - t0
+            print(f"microbatch {i + 1}: {n_seen} records, "
+                  f"rolling accuracy {n_correct / n_seen:.3f}, "
+                  f"{n_seen / dt:.0f} records/s")
+    dt = time.perf_counter() - t0
+    print(f"stream done: {n_seen} records in {dt:.2f}s "
+          f"({n_seen / dt:.0f} records/s), accuracy {n_correct / n_seen:.3f}")
+    assert n_seen == args.records
+
+
+if __name__ == "__main__":
+    main()

@@ -113,6 +113,38 @@ def test_dynsgd_fold_staleness_weights():
     tree_close(state.center, expect)
 
 
+def test_dynsgd_staleness_rotates_across_rounds():
+    """Worker i's staleness at round r is (i + r) mod W — the serialized commit
+    order rotates so no data shard is permanently down-weighted."""
+    lr = 0.05
+    model = tiny_model()
+    mesh = data_mesh(num_workers=W)
+    engine = AsyncEngine(model, "sgd", "sparse_categorical_crossentropy",
+                         DynSGDFold(), mesh, window=K, learning_rate=lr)
+    df = tiny_df()
+    plan = make_batches(df, "features", "label", B, num_workers=W, window=K)
+    state = engine.init_state()
+    state, _ = engine._round_fn(state, *engine._put_batch(*plan.round(0)))
+    center_r0 = jax.device_get(state.center)
+    state, _ = engine._round_fn(state, *engine._put_batch(*plan.round(1)))
+
+    # Manual round 1: every worker pulls center_r0, runs K steps on its round-1
+    # shard; commit i is weighted 1/(((i + 1) % W) + 1).
+    fx, fy = plan.round(1)
+    center_r0_t = jax.tree.map(jnp.asarray, center_r0)
+    expect = center_r0_t
+    for i in range(W):
+        local = manual_local_steps(model.module, center_r0_t, fx[i], fy[i], lr)
+        d = jax.tree.map(lambda a, b: a - b, local, center_r0_t)
+        w = 1.0 / (((i + 1) % W) + 1)
+        expect = jax.tree.map(lambda e, x, w=w: e + w * x, expect, d)
+    tree_close(state.center, expect)
+    # fairness: over W rounds each shard sees every staleness level exactly once
+    sched = [[(i + r) % W for i in range(W)] for r in range(W)]
+    for i in range(W):
+        assert sorted(row[i] for row in sched) == list(range(W))
+
+
 def test_aeasgd_fold_elastic_symmetry():
     rho = 0.25
     model, plan, state, lr = run_one_round(AEASGDFold(alpha=rho))
@@ -127,6 +159,39 @@ def test_aeasgd_fold_elastic_symmetry():
         local_after = jax.tree.map(lambda p, x: p + x, model.params, d)
         expect_local = jax.tree.map(lambda l, x: l - rho * x, local_after, d)
         tree_close(jax.tree.map(lambda a: a[i], state.locals_), expect_local)
+
+
+def test_per_worker_init_diversifies_replicas():
+    """Ensemble/averaging replicas must start from DIFFERENT init draws
+    (reference: per-executor deserialization + uniform_weights re-init)."""
+    model = tiny_model()
+    mesh = data_mesh(num_workers=W)
+    engine = AsyncEngine(model, "sgd", "sparse_categorical_crossentropy",
+                         EnsembleFold(), mesh, window=K, learning_rate=0.05,
+                         per_worker_init=True)
+    locals_ = jax.device_get(engine.init_state().locals_)
+    # pick a weight matrix (biases are zero-init under every draw)
+    kernels = next(a for a in jax.tree.leaves(locals_) if a.ndim >= 3)
+    for i in range(W):
+        for j in range(i + 1, W):
+            assert not np.allclose(kernels[i], kernels[j]), (i, j)
+
+
+def test_reinit_params_fallback_without_sample_spec():
+    """Models without a recorded sample spec (deserialized / Keras-ingested) get
+    the distribution-preserving permutation fallback."""
+    model = tiny_model()
+    stripped = Model(module=model.module, params=model.params)  # no sample_spec
+    p1 = stripped.reinit_params(1)
+    p2 = stripped.reinit_params(2)
+
+    def kernel(tree):  # first weight matrix; biases are permutation fixed points
+        return next(a for a in jax.tree.leaves(tree) if np.ndim(a) >= 2)
+
+    k0, k1, k2 = kernel(model.params), kernel(p1), kernel(p2)
+    assert not np.allclose(k1, k2)
+    # permutation preserves the multiset of values exactly
+    np.testing.assert_allclose(np.sort(np.ravel(k0)), np.sort(np.ravel(k1)), rtol=1e-7)
 
 
 def test_ensemble_fold_no_communication():

@@ -12,7 +12,13 @@ from distkeras_tpu.evaluators import AccuracyEvaluator, F1Evaluator, LossEvaluat
 from distkeras_tpu.metrics import MetricsLogger, scaling_efficiency
 from distkeras_tpu.models import Model
 from distkeras_tpu.models.mlp import MLP
-from distkeras_tpu.predictors import ClassPredictor, ModelPredictor, ProbabilityPredictor
+from distkeras_tpu.predictors import (
+    ClassPredictor,
+    ModelPredictor,
+    ProbabilityPredictor,
+    StreamingClassPredictor,
+    StreamingPredictor,
+)
 
 
 def tiny_model(d=4, c=3, seed=0):
@@ -43,6 +49,36 @@ def test_probability_and_class_predictors():
     np.testing.assert_allclose(probs.sum(-1), 1.0, atol=1e-5)
     cls = ClassPredictor(model, output_col="c").predict(df)["c"]
     assert cls.dtype == np.int32 and set(np.unique(cls)) <= {0, 1, 2}
+
+
+def test_streaming_predictor_matches_batch_predict():
+    """Streaming over ragged microbatches ≡ one-shot dataframe predict (the
+    Kafka streaming-inference example's correctness contract)."""
+    df = small_df(n=203)
+    model = tiny_model()
+    expect = np.asarray(ModelPredictor(model, chunk_size=64).predict(df)["prediction"])
+
+    x = np.asarray(df["features"])
+    rng = np.random.default_rng(7)
+    cuts = np.sort(rng.choice(np.arange(1, len(x)), size=11, replace=False))
+    microbatches = np.split(x, cuts)  # ragged sizes, incl. ones crossing chunks
+
+    sp = StreamingPredictor(model, chunk_size=64)
+    outs = list(sp.predict_stream(iter(microbatches)))
+    assert [len(o) for o in outs] == [len(m) for m in microbatches]  # in order
+    np.testing.assert_allclose(np.concatenate(outs, axis=0), expect, rtol=1e-5)
+
+
+def test_streaming_class_predictor_small_trickle():
+    """Single-record microbatches, total smaller than one chunk: everything
+    flushes at end-of-stream and class ids match ClassPredictor."""
+    df = small_df(n=9)
+    model = tiny_model()
+    expect = np.asarray(ClassPredictor(model, chunk_size=64).predict(df)["prediction"])
+    sp = StreamingClassPredictor(model, chunk_size=64)
+    outs = list(sp.predict_stream(row[None] for row in np.asarray(df["features"])))
+    assert len(outs) == 9 and all(len(o) == 1 for o in outs)
+    np.testing.assert_array_equal(np.concatenate(outs), expect)
 
 
 def test_accuracy_evaluator_mixed_representations():

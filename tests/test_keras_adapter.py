@@ -68,11 +68,35 @@ def test_keras_model_serialization_roundtrip():
                                np.asarray(restored.predict(x)), rtol=1e-6)
 
 
+def _keras_bn_mlp(d=4, c=3):
+    return keras.Sequential([
+        keras.layers.Dense(16, activation="relu"),
+        keras.layers.BatchNormalization(),
+        keras.layers.Dense(c),
+    ])
+
+
 def test_batchnorm_model_rejected():
     m = keras.Sequential([
         keras.layers.Dense(8, activation="relu"),
         keras.layers.BatchNormalization(),
         keras.layers.Dense(2),
     ])
-    with pytest.raises(ValueError, match="non-trainable state"):
+    with pytest.raises(ValueError, match="batchnorm='freeze'"):
         from_keras(m, sample_input=np.zeros((4, 4), np.float32))
+
+
+def test_batchnorm_freeze_ingests_and_trains():
+    """batchnorm='freeze': BN runs in inference mode (moving stats frozen) —
+    the model becomes pure, ingests cleanly, and still trains to quality."""
+    df = _df()
+    model = from_keras(_keras_bn_mlp(), sample_input=np.zeros((1, 4), np.float32),
+                       batchnorm="freeze")
+    # frozen BN contributes no trainable params: gamma/beta moved out
+    assert model.num_params == 4 * 16 + 16 + 16 * 3 + 3
+    t = SingleTrainer(model, worker_optimizer="adam",
+                      loss="sparse_categorical_crossentropy", batch_size=32,
+                      num_epoch=3, learning_rate=0.01)
+    trained = t.train(df, shuffle=True)
+    logits = np.asarray(trained.predict(jnp.asarray(df["features"])))
+    assert (logits.argmax(-1) == df["label"]).mean() > 0.9

@@ -36,6 +36,43 @@ def test_resnet_forward():
     assert m.predict(jnp.ones((2, 32, 32, 3))).shape == (2, 10)
 
 
+def test_transformer_remat_training_step_matches_dense():
+    """remat=True must be a pure memory/FLOPs trade: identical forward AND
+    identical one-step SGD update (jax.checkpoint recomputes, never changes
+    math)."""
+    import jax
+    import optax
+
+    from distkeras_tpu.models.transformer import TransformerLM
+    from distkeras_tpu.models import Model
+    from distkeras_tpu.ops.losses import get_loss
+
+    arch = dict(vocab_size=64, num_layers=2, d_model=32, num_heads=2, d_ff=64,
+                max_seq_len=16)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 16)),
+                         jnp.int32)
+    targets = jnp.asarray(np.roll(np.asarray(tokens), -1, 1), jnp.int32)
+    base = Model.build(TransformerLM(**arch), jnp.zeros((1, 16), jnp.int32))
+    loss_fn = get_loss("sparse_categorical_crossentropy")
+    tx = optax.sgd(0.1)
+
+    def one_step(module):
+        def loss_of(p):
+            return loss_fn(module.apply({"params": p}, tokens, train=True,
+                                        rngs={"dropout": jax.random.key(0)}),
+                           targets)
+
+        loss, grads = jax.jit(jax.value_and_grad(loss_of))(base.params)
+        updates, _ = tx.update(grads, tx.init(base.params), base.params)
+        return loss, optax.apply_updates(base.params, updates)
+
+    loss_d, params_d = one_step(TransformerLM(**arch))
+    loss_r, params_r = one_step(TransformerLM(**arch, remat=True))
+    np.testing.assert_allclose(float(loss_d), float(loss_r), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(params_d), jax.tree.leaves(params_r)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_transformer_forward_and_causality():
     m = small_transformer_lm(vocab_size=64, num_layers=1, d_model=32, num_heads=2,
                              d_ff=64, max_seq_len=32, seq_len=16)

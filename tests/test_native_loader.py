@@ -40,6 +40,59 @@ def test_scale_f32_matches_numpy():
     np.testing.assert_allclose(scale_f32(x, 0.5, 2.0), (x - 0.5) * 2.0, rtol=1e-6)
 
 
+def test_scale_f32_bias_matches_numpy():
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(257, 3)).astype(np.float32)
+    got = scale_f32(x, 0.25, 3.0, bias=-1.5)
+    np.testing.assert_allclose(got, (x - 0.25) * 3.0 + (-1.5), rtol=1e-6)
+
+
+def test_scale_f32_bias_exact_at_range_endpoints():
+    # The endpoints of a min-max rescale must be hit EXACTLY: (i_min - i_min) *
+    # scale + o_min == o_min in float arithmetic regardless of scale magnitude.
+    # (This is the catastrophic-cancellation case the separate bias exists for.)
+    x = np.array([2.0, 2.0 + 1e-6], np.float32)
+    scale = 1.0 / float(x[1] - x[0])  # huge scale from a degenerate range
+    out = scale_f32(x, float(x[0]), scale, bias=5.0)
+    assert out[0] == np.float32(5.0)
+
+
+def test_native_abi_version_pinned_to_source():
+    # The ctypes declarations are only valid for the exact C signatures they
+    # were written against. dk_abi_version() pins them: this test fails if
+    # loader.cc's version constant and the Python _ABI_VERSION ever diverge
+    # (i.e. someone changed a signature on one side only).
+    import ctypes
+    import re
+
+    from distkeras_tpu.data import native_loader
+
+    src = open(native_loader._SRC).read()
+    m = re.search(r"int\s+dk_abi_version\(\)\s*\{\s*return\s+(\d+)\s*;", src)
+    assert m, "loader.cc must define dk_abi_version()"
+    assert int(m.group(1)) == native_loader._ABI_VERSION, (
+        "native ABI version mismatch between loader.cc and native_loader.py — "
+        "a signature changed on one side only"
+    )
+    lib = get_lib()
+    if lib is not None:
+        assert lib.dk_abi_version() == native_loader._ABI_VERSION
+
+
+def test_min_max_semantics_through_native_path():
+    # End-to-end guard for the data plane: MinMaxTransformer output must map
+    # [i_min, i_max] -> [o_min, o_max] with exact endpoints via the native path.
+    from distkeras_tpu.data import DataFrame
+    from distkeras_tpu.data.transformers import MinMaxTransformer
+
+    x = np.array([[0.0], [255.0], [51.0]], np.float32)
+    df = DataFrame({"features": x})
+    out = MinMaxTransformer(o_min=-1.0, o_max=1.0).transform(df)["features_normalized"]
+    assert out[0, 0] == np.float32(-1.0)
+    assert out[1, 0] == np.float32(1.0)
+    np.testing.assert_allclose(out[2, 0], -1.0 + 2.0 * 51.0 / 255.0, rtol=1e-6)
+
+
 def test_batch_plan_uses_gather(tmp_path):
     from distkeras_tpu.data import DataFrame, make_batches
 

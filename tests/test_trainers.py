@@ -109,3 +109,42 @@ def test_num_workers_defaults_to_all_devices():
     t.train(df)
     # mesh defaulted to all 8 virtual devices
     assert t.get_history() is not None
+
+
+def test_legacy_socket_kwargs_accepted_and_ignored():
+    """Reference notebooks pass master_port etc.; they must port by deleting
+    imports only, not by editing every ctor call (accept-and-warn)."""
+    with pytest.warns(DeprecationWarning, match="socket-era"):
+        t = ADAG(tiny_model(), master_port=5000, master_host="driver", **COMMON)
+    assert not hasattr(t, "master_port")
+    with pytest.raises(TypeError, match="unexpected kwargs"):
+        ADAG(tiny_model(), definitely_a_typo=1, **COMMON)
+
+
+def test_per_worker_histories_surface():
+    df = blob_df()
+    t = ADAG(tiny_model(), num_workers=4, communication_window=4, **COMMON)
+    t.train(df, shuffle=True)
+    wh = t.get_worker_histories()
+    assert wh is not None and wh.shape[0] == 4 and wh.shape[1] == len(t.get_history())
+    np.testing.assert_allclose(wh.mean(axis=0), t.get_history(), rtol=1e-5)
+    # different data shards -> (generically) different loss curves
+    assert not np.allclose(wh[0], wh[1])
+    # sync trainers have no divergent replicas to report
+    ts = SingleTrainer(tiny_model(), **COMMON)
+    ts.train(df)
+    assert ts.get_worker_histories() is None
+
+
+def test_run_config_backs_trainer_kwargs():
+    """The kwargs-first surface normalizes into a frozen RunConfig and the
+    legacy attribute names stay live (read AND write) over it."""
+    t = DynSGD(tiny_model(), batch_size=64, communication_window=7,
+               learning_rate=0.02, num_workers=2, **{
+                   k: v for k, v in COMMON.items()
+                   if k not in ("batch_size", "learning_rate")})
+    assert t.config.batch_size == 64 and t.batch_size == 64
+    assert t.config.communication_window == 7 and t.communication_window == 7
+    assert t.config.num_workers == 2 and t.num_workers == 2
+    t.batch_size = 32  # assignment must write through to the config
+    assert t.config.batch_size == 32
