@@ -8,7 +8,9 @@ distributed_initialize``). ``spark-submit --num-executors N`` becomes "one proce
 TPU host, N = process_count x chips_per_host".
 
 Launching is via ssh (TPU-VM style) or a user-supplied runner; ``dry_run`` renders
-the exact per-host command lines without executing (and is all that CI exercises).
+the exact per-host command lines without executing. CI exercises both: dry-run
+rendering (``tests/test_datasets_jobs.py``) and a real localhost 2-process launch
+(``tests/test_multihost.py``).
 """
 
 from __future__ import annotations
@@ -17,6 +19,7 @@ import dataclasses
 import json
 import shlex
 import subprocess
+import time
 from typing import Optional, Sequence
 
 
@@ -74,10 +77,31 @@ class Job:
             if host in ("localhost", "127.0.0.1"):
                 self._procs.append(subprocess.Popen(cmd, shell=True))
             else:
+                # -tt forces a remote pty: killing the local ssh client then
+                # HUPs the remote job too, so kill() tears down the whole
+                # launch rather than orphaning trainers on the pod hosts.
                 self._procs.append(
-                    subprocess.Popen(["ssh", target, cmd])
+                    subprocess.Popen(["ssh", "-tt", target, cmd])
                 )
         return cmds
 
-    def wait(self) -> list[int]:
-        return [p.wait() for p in self._procs]
+    def wait(self, timeout: Optional[float] = None) -> list[int]:
+        """Block until every launched process exits; returns their exit codes.
+
+        ``timeout`` bounds the *total* wait (seconds); on expiry the pending
+        ``subprocess.TimeoutExpired`` propagates with the stragglers still
+        running (callers decide whether to kill).
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        rcs = []
+        for p in self._procs:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            rcs.append(p.wait(timeout=remaining))
+        return rcs
+
+    def kill(self) -> None:
+        """Kill and reap every launched process that is still running."""
+        for p in self._procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()

@@ -35,7 +35,7 @@ from distkeras_tpu.ops.collectives import shard_map
 from distkeras_tpu.ops.losses import get_loss
 from distkeras_tpu.ops.optimizers import get_optimizer
 from distkeras_tpu.parallel.disciplines import Discipline
-from distkeras_tpu.runtime.mesh import DATA_AXIS
+from distkeras_tpu.runtime.mesh import DATA_AXIS, put_global
 from distkeras_tpu.workers import make_local_loop
 
 
@@ -156,16 +156,16 @@ class AsyncEngine:
         rep = NamedSharding(self.mesh, P())
         shard = NamedSharding(self.mesh, P(DATA_AXIS))
         return EngineState(
-            center=jax.device_put(center, rep),
-            locals_=jax.device_put(locals_, shard),
-            opt_state=jax.device_put(opt_state, shard),
-            fold_state=jax.device_put(fold_state, rep),
-            rng=jax.device_put(rng, rep),
+            center=put_global(center, rep),
+            locals_=put_global(locals_, shard),
+            opt_state=put_global(opt_state, shard),
+            fold_state=put_global(fold_state, rep),
+            rng=put_global(rng, rep),
         )
 
     def _put_batch(self, xs: np.ndarray, ys: np.ndarray):
         shard = NamedSharding(self.mesh, P(DATA_AXIS))
-        return jax.device_put(xs, shard), jax.device_put(ys, shard)
+        return put_global(xs, shard), put_global(ys, shard)
 
     def run(
         self,

@@ -20,7 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distkeras_tpu.ops.losses import collect_aux_loss, get_loss
 from distkeras_tpu.ops.optimizers import get_optimizer
 from distkeras_tpu.parallel.sharding import param_shardings
-from distkeras_tpu.runtime.mesh import DATA_AXIS
+from distkeras_tpu.runtime.mesh import DATA_AXIS, put_global
 
 
 class GSPMDState(NamedTuple):
@@ -79,10 +79,10 @@ class GSPMDEngine:
     def init_state(self) -> GSPMDState:
         params = jax.tree.map(lambda a: np.array(a), self.model.params)
         shardings = param_shardings(params, self.mesh, self.rules)
-        params = jax.device_put(params, shardings)
+        params = put_global(params, shardings)
         opt_state = jax.jit(self.tx.init)(params)
-        rng = jax.device_put(jax.random.key(self.seed),
-                             NamedSharding(self.mesh, P()))
+        rng = put_global(jax.random.key(self.seed),
+                          NamedSharding(self.mesh, P()))
         return GSPMDState(params, opt_state, rng)
 
     def batch_sharding(self) -> NamedSharding:

@@ -29,7 +29,7 @@ from distkeras_tpu.ops.collectives import shard_map
 from distkeras_tpu.ops.losses import get_loss
 from distkeras_tpu.ops.optimizers import get_optimizer
 from distkeras_tpu.parallel.pipeline import gpipe
-from distkeras_tpu.runtime.mesh import DATA_AXIS, PIPE_AXIS
+from distkeras_tpu.runtime.mesh import DATA_AXIS, PIPE_AXIS, put_global
 
 
 class PipeState(NamedTuple):
@@ -188,10 +188,10 @@ class PipelineEngine:
         rep, stage = split_transformer_params(params, self.num_stages)
         rep_sh = NamedSharding(self.mesh, P())
         stage_sh = NamedSharding(self.mesh, P(PIPE_AXIS))
-        rep = jax.device_put(rep, rep_sh)
-        stage = jax.device_put(stage, stage_sh)
+        rep = put_global(rep, rep_sh)
+        stage = put_global(stage, stage_sh)
         opt_state = jax.jit(self.tx.init)((rep, stage))
-        rng = jax.device_put(jax.random.key(self.seed), rep_sh)
+        rng = put_global(jax.random.key(self.seed), rep_sh)
         return PipeState((rep, stage), opt_state, rng)
 
     def batch_sharding(self) -> NamedSharding:

@@ -27,7 +27,7 @@ from distkeras_tpu.ops.collectives import shard_map
 from distkeras_tpu.ops.losses import get_loss
 from distkeras_tpu.ops.optimizers import get_optimizer
 from distkeras_tpu.parallel.sharding import param_shardings
-from distkeras_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from distkeras_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, put_global
 
 
 class SPMDState(NamedTuple):
@@ -146,9 +146,9 @@ class SPMDEngine:
     def init_state(self) -> SPMDState:
         params = jax.tree.map(lambda a: np.array(a), self.model.params)
         shardings = param_shardings(params, self.mesh, self.tp_rules)
-        params = jax.device_put(params, shardings)
+        params = put_global(params, shardings)
         opt_state = jax.jit(self.tx.init)(params)  # inherits param shardings
-        rng = jax.device_put(
+        rng = put_global(
             jax.random.key(self.seed), NamedSharding(self.mesh, P())
         )
         return SPMDState(params=params, opt_state=opt_state, rng=rng)

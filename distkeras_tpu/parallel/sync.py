@@ -25,7 +25,7 @@ from distkeras_tpu.data.batching import BatchPlan
 from distkeras_tpu.ops.collectives import shard_map
 from distkeras_tpu.ops.losses import get_loss
 from distkeras_tpu.ops.optimizers import get_optimizer
-from distkeras_tpu.runtime.mesh import DATA_AXIS
+from distkeras_tpu.runtime.mesh import DATA_AXIS, put_global
 from distkeras_tpu.workers import make_local_loop
 
 
@@ -97,9 +97,9 @@ class SyncEngine:
         # Deep-copy: round_fn donates its input state; never alias the user's Model.
         params = jax.tree.map(lambda a: np.array(a), self.model.params)
         return SyncState(
-            params=jax.device_put(params, rep),
-            opt_state=jax.device_put(self.tx.init(params), rep),
-            rng=jax.device_put(jax.random.key(self.seed), rep),
+            params=put_global(params, rep),
+            opt_state=put_global(self.tx.init(params), rep),
+            rng=put_global(jax.random.key(self.seed), rep),
         )
 
     def run(
@@ -123,7 +123,7 @@ class SyncEngine:
 
         def stage(r):
             fx, fy = plan.round(r)
-            return jax.device_put(fx, shard), jax.device_put(fy, shard)
+            return put_global(fx, shard), put_global(fy, shard)
 
         feeder = RoundFeeder(plan.num_rounds, stage, start_round=start_round)
         for r, (xs, ys) in feeder:

@@ -18,7 +18,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.data.dataframe import DataFrame
 from distkeras_tpu.models.base import Model
-from distkeras_tpu.runtime.mesh import DATA_AXIS, data_mesh
+from distkeras_tpu.runtime.mesh import DATA_AXIS, data_mesh, put_global
 
 
 class Predictor:
@@ -50,11 +50,15 @@ class ModelPredictor(Predictor):
         self.mesh = data_mesh(num_workers=num_workers)
         W = self.mesh.shape[DATA_AXIS]
         self.chunk_size = max(chunk_size // W, 1) * W  # divisible by worker count
-        self._fwd = jax.jit(
-            lambda params, x: self.model.module.apply({"params": params}, x, train=False)
-        )
         rep = NamedSharding(self.mesh, P())
-        self._params = jax.device_put(self.model.params, rep)
+        # out_shardings=replicated: the gathered predictions are fully
+        # addressable on every process (multi-host predict works; one small
+        # all-gather per chunk otherwise fused away single-process).
+        self._fwd = jax.jit(
+            lambda params, x: self.model.module.apply({"params": params}, x, train=False),
+            out_shardings=rep,
+        )
+        self._params = put_global(self.model.params, rep)
         self._shard = NamedSharding(self.mesh, P(DATA_AXIS))
 
     def _postprocess(self, out: np.ndarray) -> np.ndarray:
@@ -72,7 +76,7 @@ class ModelPredictor(Predictor):
             pad = self.chunk_size - len(chunk)
             if pad:
                 chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad, axis=0)])
-            xb = jax.device_put(jnp.asarray(chunk), self._shard)
+            xb = put_global(np.asarray(chunk), self._shard)
             out = np.asarray(self._fwd(self._params, xb))
             outs.append(out[: len(out) - pad] if pad else out)
         return self._postprocess(np.concatenate(outs, axis=0))

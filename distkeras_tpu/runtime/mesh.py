@@ -101,6 +101,44 @@ def hybrid_mesh(
     return Mesh(grid, tuple(names))
 
 
+def put_global(tree, sharding):
+    """``device_put`` that also works when ``sharding`` spans multiple processes.
+
+    Single-process (the common chip-local case) this is exactly
+    ``jax.device_put``. Multi-process, ``jax.device_put`` refuses shardings
+    with non-addressable devices; instead every process — which by the
+    data-plane contract holds the identical full host value (deterministic
+    ``BatchPlan``/init) — hands each of *its* devices the shard it owns via
+    :func:`jax.make_array_from_callback`, assembling one global ``jax.Array``.
+
+    PRNG key arrays (extended dtypes) can't go through the callback path; they
+    are rebuilt on-device from their raw ``key_data`` inside a tiny jitted
+    program with ``out_shardings``.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(tree, sharding)
+
+    def _one(x, sh):
+        if isinstance(x, jax.Array) and jax.dtypes.issubdtype(
+            x.dtype, jax.dtypes.prng_key
+        ):
+            data = np.asarray(jax.random.key_data(x))
+            impl = jax.random.key_impl(x)
+            g = jax.make_array_from_callback(data.shape, sh, lambda idx: data[idx])
+            return jax.jit(
+                lambda d: jax.random.wrap_key_data(d, impl=impl),
+                out_shardings=sh,
+            )(g)
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(arr.shape, sh, lambda idx: arr[idx])
+
+    if isinstance(sharding, jax.sharding.Sharding):
+        return jax.tree.map(lambda x: _one(x, sharding), tree)
+    # `sharding` is a pytree matching `tree` (per-leaf shardings, as
+    # param_shardings produces).
+    return jax.tree.map(_one, tree, sharding)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     """Sharding for the center variable: fully replicated across the mesh."""
     return NamedSharding(mesh, P())

@@ -1,0 +1,82 @@
+"""Worker script for the 2-process DCN bootstrap test (launched by ``Job``).
+
+Each process pins the CPU platform with 2 virtual devices, joins the
+``jax.distributed`` coordination service over loopback (the DCN path of
+SURVEY.md §5's distributed-backend row), and runs one synchronous-DP training
+job over the resulting 4-device *global* mesh through the real user-facing
+``SynchronousDistributedTrainer`` API. Results land in ``$DK_OUT/proc<i>.json``
+for the parent test to cross-check.
+
+Run only via ``tests/test_multihost.py`` (it renders the env through
+``job_deployment.Job`` — the same machinery a real pod launch uses).
+"""
+
+import json
+import os
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main() -> None:
+    from distkeras_tpu import DataFrame, SynchronousDistributedTrainer
+    from distkeras_tpu.models import Model
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.runtime.mesh import distributed_initialize
+
+    # The Job/Punchcard launcher renders these for every host (job_deployment.py).
+    coordinator = os.environ["JAX_COORDINATOR_ADDRESS"]
+    num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    process_id = int(os.environ["JAX_PROCESS_ID"])
+
+    distributed_initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    assert jax.process_count() == num_processes, (
+        f"expected {num_processes} processes, got {jax.process_count()}"
+    )
+
+    # Identical deterministic data on every process: global device_put of a
+    # host array to a sharded layout requires per-process agreement, which a
+    # deterministic plan gives for free (the multi-host data-plane contract).
+    rng = np.random.default_rng(0)
+    n, d, c = 512, 4, 3
+    centers = rng.normal(scale=4.0, size=(c, d))
+    y = rng.integers(0, c, size=n)
+    x = centers[y] + rng.normal(scale=0.5, size=(n, d))
+    df = DataFrame({"features": x.astype(np.float32), "label": y.astype(np.int32)})
+
+    model = Model.build(MLP(hidden=(16,), num_outputs=c),
+                        np.zeros((1, d), np.float32), seed=0)
+    trainer = SynchronousDistributedTrainer(
+        model, loss="sparse_categorical_crossentropy",
+        num_workers=jax.device_count(),  # the full global mesh, both processes
+        batch_size=16, num_epoch=2, learning_rate=0.1,
+    )
+    trained = trainer.train(df)
+
+    logits = np.asarray(trained.predict(np.asarray(x, np.float32)))
+    acc = float((logits.argmax(-1) == y).mean())
+    out = {
+        "process": process_id,
+        "process_count": jax.process_count(),
+        "global_devices": jax.device_count(),
+        "local_devices": jax.local_device_count(),
+        "history": [float(v) for v in trainer.get_history()],
+        "accuracy": acc,
+    }
+    path = os.path.join(os.environ["DK_OUT"], f"proc{process_id}.json")
+    with open(path, "w") as f:
+        json.dump(out, f)
+    print(f"proc {process_id}: acc={acc:.3f} devices={jax.device_count()}")
+
+
+if __name__ == "__main__":
+    main()
