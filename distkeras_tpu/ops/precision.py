@@ -1,0 +1,34 @@
+"""Mixed-precision casting.
+
+The canonical TPU recipe (one knob, ``compute_dtype="bfloat16"``): master
+params, gradients, and optimizer state stay float32; the fwd/bwd computation
+runs with params *and* activations cast to bfloat16 so every matmul/conv hits
+the MXU at its bf16 rate. Casting activations alone is a half-measure — dtype
+promotion with float32 params drags the convs back to float32 (measured on
+v5e: CIFAR-10 CNN 30 -> 46 TFLOPS/chip from casting params too). Loss and
+normalization statistics still accumulate in float32 (flax computes norm
+stats in float32 regardless of input dtype).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cast_floats(tree, dtype):
+    """Cast every floating leaf of ``tree`` to ``dtype`` (no-op if ``None``).
+
+    Non-float leaves (token ids, masks, PRNG keys) pass through untouched.
+    Inside a loss closure this is the mixed-precision boundary: the cast's
+    cotangent upcasts gradients back to the master dtype automatically.
+    """
+    if dtype is None:
+        return tree
+
+    def c(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(c, tree)

@@ -28,6 +28,7 @@ from typing import Any, Callable, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.data.batching import BatchPlan
@@ -79,6 +80,7 @@ class AsyncEngine:
         self._local_loop = make_local_loop(
             model.module, self.loss_fn, self.tx, compute_dtype=compute_dtype
         )
+        self._multi_fns = {}
         self._round_fn = self._build_round_fn()
 
     # ------------------------------------------------------------------
@@ -132,7 +134,20 @@ class AsyncEngine:
             )
             return EngineState(center, locals_, opt_state, fold_state, rng), loss
 
+        self._round_core = round_fn
         return jax.jit(round_fn, donate_argnums=(0,))
+
+    def multi_round_fn(self, rounds: int):
+        """A jitted program executing ``rounds`` consecutive fold rounds.
+
+        Semantically identical to calling the per-round program ``rounds``
+        times — the scan carries the exact same EngineState — but one host
+        dispatch covers the whole block. On dispatch-latency-heavy paths
+        (e.g. a tunneled device, ~4ms/call measured) this is the difference
+        between host-bound and device-bound throughput for small models.
+        Batches are ``[rounds, W, K, B, ...]``; returns losses ``[rounds, W]``.
+        """
+        return make_multi_round_fn(self, rounds)
 
     # ------------------------------------------------------------------
     def init_state(self) -> EngineState:
@@ -173,6 +188,7 @@ class AsyncEngine:
         state: Optional[EngineState] = None,
         start_round: int = 0,
         on_round: Optional[Callable] = None,
+        rounds_per_program: int = 1,
     ):
         """Execute fold rounds ``start_round..num_rounds`` (resume-aware).
 
@@ -189,16 +205,78 @@ class AsyncEngine:
             )
         if state is None:
             state = self.init_state()
-        losses = []
-        from distkeras_tpu.data.prefetch import RoundFeeder
+        if rounds_per_program <= 1:
+            losses = []
+            from distkeras_tpu.data.prefetch import RoundFeeder
 
-        feeder = RoundFeeder(plan.num_rounds,
-                             lambda r: self._put_batch(*plan.round(r)),
-                             start_round=start_round)
-        for r, (xs, ys) in feeder:
-            new_state, loss = self._round_fn(state, xs, ys)
-            losses.append(loss)
-            if on_round is not None:
-                on_round(r, loss, new_state)
-            state = new_state
-        return state, np.asarray([np.asarray(l) for l in losses])
+            feeder = RoundFeeder(plan.num_rounds,
+                                 lambda r: self._put_batch(*plan.round(r)),
+                                 start_round=start_round)
+            for r, (xs, ys) in feeder:
+                new_state, loss = self._round_fn(state, xs, ys)
+                losses.append(loss)
+                if on_round is not None:
+                    on_round(r, loss, new_state)
+                state = new_state
+            return state, np.asarray([np.asarray(l) for l in losses])
+        return run_blocked(self, plan, state, start_round, on_round,
+                           rounds_per_program)
+
+
+def run_blocked(engine, plan, state, start_round, on_round, R):
+    """Engine run loop with ``R`` rounds per compiled program (one dispatch per
+    block; see ``multi_round_fn``). Loss histories are identical to the
+    per-round path; ``on_round`` still fires once per round but only the
+    block-final call carries a state (interior calls get ``None`` — their
+    states never materialize on the host). Shared by the async and sync
+    engines."""
+    from distkeras_tpu.data.prefetch import RoundFeeder
+
+    starts = list(range(start_round, plan.num_rounds, R))
+    # Blocked batches are [R, W, K, B, ...]: the worker axis moves to dim 1.
+    shard = NamedSharding(engine.mesh, P(None, DATA_AXIS))
+
+    def stage(i):
+        rs = range(starts[i], min(starts[i] + R, plan.num_rounds))
+        batches = [plan.round(r) for r in rs]
+        xs = np.stack([b[0] for b in batches])
+        ys = np.stack([b[1] for b in batches])
+        return put_global(xs, shard), put_global(ys, shard)
+
+    losses = []
+    feeder = RoundFeeder(len(starts), stage)
+    for i, (xs, ys) in feeder:
+        n = xs.shape[0]
+        new_state, block_losses = engine.multi_round_fn(n)(state, xs, ys)
+        host_losses = np.asarray(block_losses)
+        if on_round is not None:
+            for j in range(n):
+                # Only the block-final call carries state: interior rounds'
+                # states never exist on the host, and handing out the
+                # block-final state under an interior round label would let a
+                # checkpoint resume re-apply rounds it already contains.
+                st = new_state if j == n - 1 else None
+                on_round(starts[i] + j, host_losses[j], st)
+        losses.extend(host_losses)
+        state = new_state
+    return state, np.asarray(losses)
+
+
+def make_multi_round_fn(engine, rounds: int):
+    """Build/cache a jitted ``rounds``-per-dispatch program from an engine's
+    unjitted ``_round_core`` (see ``AsyncEngine.multi_round_fn``)."""
+    fn = engine._multi_fns.get(rounds)
+    if fn is None:
+        core = engine._round_core
+
+        def multi(state, xs_stack, ys_stack):
+            def body(st, xy):
+                st2, loss = core(st, *xy)
+                return st2, loss
+
+            state, losses = lax.scan(body, state, (xs_stack, ys_stack))
+            return state, losses
+
+        fn = jax.jit(multi, donate_argnums=(0,))
+        engine._multi_fns[rounds] = fn
+    return fn

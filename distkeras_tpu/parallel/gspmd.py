@@ -18,6 +18,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.ops.losses import collect_aux_loss, get_loss
+from distkeras_tpu.ops.precision import cast_floats
 from distkeras_tpu.ops.optimizers import get_optimizer
 from distkeras_tpu.parallel.sharding import param_shardings
 from distkeras_tpu.runtime.mesh import DATA_AXIS, put_global
@@ -40,6 +41,7 @@ class GSPMDEngine:
         learning_rate: float = 0.01,
         seed: int = 0,
         aux_loss_weight: float = 0.0,
+        compute_dtype=None,
     ):
         self.model = model
         self.mesh = mesh
@@ -52,19 +54,23 @@ class GSPMDEngine:
         loss_fn = self.loss_fn
         tx = self.tx
         aux_w = self.aux_loss_weight
+        self.compute_dtype = compute_dtype
+        dtype = compute_dtype
 
         def step(state: GSPMDState, x, y):
             def loss_of(p, rng):
+                p = cast_floats(p, dtype)
+                xc = cast_floats(x, dtype)
                 if aux_w:
                     # Collect sown intermediates (MoE router load-balancing
                     # loss) and add them to the task loss.
                     out, mut = module.apply(
-                        {"params": p}, x, train=True, rngs={"dropout": rng},
-                        mutable=["intermediates"],
+                        {"params": p}, xc, train=True,
+                        rngs={"dropout": rng}, mutable=["intermediates"],
                     )
                     return (loss_fn(out.astype(jnp.float32), y)
                             + aux_w * collect_aux_loss(mut))
-                out = module.apply({"params": p}, x, train=True,
+                out = module.apply({"params": p}, xc, train=True,
                                    rngs={"dropout": rng})
                 return loss_fn(out.astype(jnp.float32), y)
 

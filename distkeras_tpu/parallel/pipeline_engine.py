@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distkeras_tpu.models.transformer import TransformerBlock, TransformerLM
 from distkeras_tpu.ops.collectives import shard_map
 from distkeras_tpu.ops.losses import get_loss
+from distkeras_tpu.ops.precision import cast_floats
 from distkeras_tpu.ops.optimizers import get_optimizer
 from distkeras_tpu.parallel.pipeline import gpipe
 from distkeras_tpu.runtime.mesh import DATA_AXIS, PIPE_AXIS, put_global
@@ -87,6 +88,7 @@ class PipelineEngine:
         num_microbatches: int = 4,
         learning_rate: float = 0.01,
         seed: int = 0,
+        compute_dtype=None,
     ):
         tl = model.module
         if not isinstance(tl, TransformerLM):
@@ -102,6 +104,7 @@ class PipelineEngine:
             tl.num_heads, tl.d_model, tl.d_ff, dropout_rate=tl.dropout_rate
         )
         self.tl = tl
+        self.compute_dtype = compute_dtype
         self._step = self._build_step()
 
     # -- pure functions ----------------------------------------------------
@@ -113,7 +116,7 @@ class PipelineEngine:
         B, L = tokens.shape
         x = rep["tok_embed"]["embedding"][tokens]
         x = x + rep["pos_embed"]["embedding"][jnp.arange(L)][None]
-        x = x.astype(jnp.float32)
+        x = x.astype(self.compute_dtype or jnp.float32)
 
         local_sp = jax.tree.map(lambda a: jnp.squeeze(a, 0), stage_params)
 
@@ -139,6 +142,8 @@ class PipelineEngine:
             idx = lax.axis_index(PIPE_AXIS)
 
             def loss_of(rep, stage):
+                rep = cast_floats(rep, self.compute_dtype)
+                stage = cast_floats(stage, self.compute_dtype)
                 logits = self._forward(rep, stage, tokens, rng)
                 per = loss_fn(logits.astype(jnp.float32), targets)
                 # Only the last stage's logits are real. Mask LOCALLY and do NOT

@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.ops.collectives import shard_map
 from distkeras_tpu.ops.losses import get_loss
+from distkeras_tpu.ops.precision import cast_floats
 from distkeras_tpu.ops.optimizers import get_optimizer
 from distkeras_tpu.parallel.sharding import param_shardings
 from distkeras_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, put_global
@@ -75,6 +76,7 @@ class SPMDEngine:
         learning_rate: float = 0.01,
         seed: int = 0,
         aux_loss_weight: float = 0.0,
+        compute_dtype=None,
     ):
         self.model = model
         self.mesh = mesh
@@ -83,6 +85,7 @@ class SPMDEngine:
         self.tp_rules = tp_rules
         self.seed = seed
         self.aux_loss_weight = float(aux_loss_weight)
+        self.compute_dtype = compute_dtype
         self.manual_axes = frozenset(
             a for a in (DATA_AXIS, SEQ_AXIS) if mesh.shape.get(a, 1) >= 1
         )
@@ -94,6 +97,7 @@ class SPMDEngine:
         tx = self.tx
         manual = self.manual_axes
         aux_w = self.aux_loss_weight
+        dtype = self.compute_dtype
 
         def body(params, opt_state, rng, tokens, targets):
             step_rng = jax.random.fold_in(
@@ -102,6 +106,7 @@ class SPMDEngine:
             )
 
             def loss_of(p):
+                p = cast_floats(p, dtype)
                 if aux_w:
                     logits, mut = module.apply(
                         {"params": p}, tokens, train=True,

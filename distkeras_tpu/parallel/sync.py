@@ -53,6 +53,7 @@ class SyncEngine:
         self.tx = get_optimizer(optimizer, learning_rate)
         self.loss_fn = get_loss(loss)
         self.compute_dtype = compute_dtype
+        self._multi_fns = {}
         self._round_fn = self._build_round_fn()
 
     def _build_round_fn(self):
@@ -90,7 +91,19 @@ class SyncEngine:
             )
             return SyncState(params, opt_state, rng), jnp.mean(losses)
 
+        self._round_core = round_fn
         return jax.jit(round_fn, donate_argnums=(0,))
+
+    def multi_round_fn(self, rounds: int):
+        """``rounds`` sync steps in one dispatched program (see
+        ``AsyncEngine.multi_round_fn`` — identical semantics, scanned state)."""
+        from distkeras_tpu.parallel.engine import make_multi_round_fn
+
+        return make_multi_round_fn(self, rounds)
+
+    def _put_batch(self, xs, ys):
+        shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        return put_global(xs, shard), put_global(ys, shard)
 
     def init_state(self) -> SyncState:
         rep = NamedSharding(self.mesh, P())
@@ -108,6 +121,7 @@ class SyncEngine:
         state: Optional[SyncState] = None,
         start_round: int = 0,
         on_round: Optional[Callable] = None,
+        rounds_per_program: int = 1,
     ):
         """Execute rounds ``start_round..num_rounds``; ``on_round(r, loss, state)``
         (see AsyncEngine.run for the donation caveat)."""
@@ -117,15 +131,17 @@ class SyncEngine:
             )
         if state is None:
             state = self.init_state()
-        shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        if rounds_per_program > 1:
+            from distkeras_tpu.parallel.engine import run_blocked
+
+            return run_blocked(self, plan, state, start_round, on_round,
+                               rounds_per_program)
         losses = []
         from distkeras_tpu.data.prefetch import RoundFeeder
 
-        def stage(r):
-            fx, fy = plan.round(r)
-            return put_global(fx, shard), put_global(fy, shard)
-
-        feeder = RoundFeeder(plan.num_rounds, stage, start_round=start_round)
+        feeder = RoundFeeder(plan.num_rounds,
+                             lambda r: self._put_batch(*plan.round(r)),
+                             start_round=start_round)
         for r, (xs, ys) in feeder:
             new_state, loss = self._round_fn(state, xs, ys)
             losses.append(loss)
