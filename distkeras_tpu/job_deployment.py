@@ -99,6 +99,28 @@ class Job:
             rcs.append(p.wait(timeout=remaining))
         return rcs
 
+    def poll(self) -> list:
+        """Exit codes so far: one entry per host, ``None`` while running."""
+        return [p.poll() for p in self._procs]
+
+    def supervise(self, timeout: float, grace: float = 5.0) -> list[int]:
+        """Babysit the job like a cluster manager: poll until every process
+        exits, or until the first nonzero exit (a failed host) — then give the
+        survivors ``grace`` seconds and tear the job down. Returns exit codes
+        (``-9`` for processes the teardown killed). This is the host-failure
+        detection the reference delegated to Spark's task retry."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            rcs = self.poll()
+            if all(rc is not None for rc in rcs):
+                return rcs
+            if any(rc not in (None, 0) for rc in rcs):
+                time.sleep(grace)
+                break
+            time.sleep(0.5)
+        self.kill()
+        return [p.returncode for p in self._procs]
+
     def kill(self) -> None:
         """Kill and reap every launched process that is still running."""
         for p in self._procs:

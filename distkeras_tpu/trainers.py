@@ -94,6 +94,7 @@ class Trainer:
         checkpoint_every: int = 0,
         resume: bool = False,
         rounds_per_program: int = 1,
+        on_round=None,
         **kwargs,
     ):
         legacy = {k: kwargs.pop(k) for k in list(kwargs) if k in _LEGACY_SOCKET_KWARGS}
@@ -131,6 +132,10 @@ class Trainer:
         #: dispatch latency, not the device, bounds small-model throughput.
         #: Checkpoints then land on block boundaries (exact-resume-safe).
         self.rounds_per_program = int(rounds_per_program)
+        #: optional ``f(round, loss)`` fired after every fold round (the
+        #: Keras-callback-shaped progress hook; reference workers printed
+        #: per-batch logs on executors — here the driver sees every round).
+        self.on_round = on_round
         self.history: np.ndarray | None = None
         self.worker_histories: np.ndarray | None = None
         self.training_time: float = 0.0
@@ -178,6 +183,8 @@ class Trainer:
         def on_round(r, loss, st):
             if logger is not None:
                 logger(r, loss)
+            if self.on_round is not None:
+                self.on_round(r, loss)
             if ckpt is None or not self.checkpoint_every:
                 return
             if (r + 1) % self.checkpoint_every == 0 or r == plan.num_rounds - 1:

@@ -47,7 +47,10 @@ def main() -> None:
     # host array to a sharded layout requires per-process agreement, which a
     # deterministic plan gives for free (the multi-host data-plane contract).
     rng = np.random.default_rng(0)
-    n, d, c = 512, 4, 3
+    # n=1024 -> 4 fold rounds on the 4-device mesh (batch 16, window 8,
+    # 2 epochs): enough for the fault test to kill at round 2 with a complete
+    # checkpoint behind it.
+    n, d, c = 1024, 4, 3
     centers = rng.normal(scale=4.0, size=(c, d))
     y = rng.integers(0, c, size=n)
     x = centers[y] + rng.normal(scale=0.5, size=(n, d))
@@ -55,10 +58,25 @@ def main() -> None:
 
     model = Model.build(MLP(hidden=(16,), num_outputs=c),
                         np.zeros((1, d), np.float32), seed=0)
+
+    # Fault injection (the elastic-recovery test): DK_DIE_AT_ROUND makes the
+    # process with id DK_DIE_PROC abort hard — no cleanup, like a preempted or
+    # OOM-killed pod host — after that fold round completes.
+    die_at = os.environ.get("DK_DIE_AT_ROUND")
+    die_proc = int(os.environ.get("DK_DIE_PROC", "1"))
+
+    def fault(r, loss):
+        if die_at is not None and process_id == die_proc and r == int(die_at):
+            os._exit(17)
+
     trainer = SynchronousDistributedTrainer(
         model, loss="sparse_categorical_crossentropy",
         num_workers=jax.device_count(),  # the full global mesh, both processes
         batch_size=16, num_epoch=2, learning_rate=0.1,
+        checkpoint_dir=os.environ.get("DK_CKPT_DIR") or None,
+        checkpoint_every=int(os.environ.get("DK_CKPT_EVERY", "0")),
+        resume=os.environ.get("DK_RESUME") == "1",
+        on_round=fault,
     )
     trained = trainer.train(df)
 
