@@ -56,8 +56,26 @@ from distkeras_tpu.trainers import (  # noqa: F401
     SynchronousDistributedTrainer,
     Trainer,
 )
-from distkeras_tpu.data import DataFrame  # noqa: F401
+from distkeras_tpu.data import (  # noqa: F401
+    DataFrame,
+    DenseTransformer,
+    LabelIndexTransformer,
+    MinMaxTransformer,
+    OneHotTransformer,
+    ReshapeTransformer,
+    Transformer,
+)
 from distkeras_tpu.models import Model  # noqa: F401
+from distkeras_tpu.predictors import (  # noqa: F401
+    ClassPredictor,
+    ModelPredictor,
+    ProbabilityPredictor,
+)
+from distkeras_tpu.evaluators import (  # noqa: F401
+    AccuracyEvaluator,
+    F1Evaluator,
+    LossEvaluator,
+)
 
 __all__ = [
     "Trainer",
@@ -71,6 +89,18 @@ __all__ = [
     "AveragingTrainer",
     "EnsembleTrainer",
     "DataFrame",
+    "Transformer",
+    "LabelIndexTransformer",
+    "OneHotTransformer",
+    "MinMaxTransformer",
+    "ReshapeTransformer",
+    "DenseTransformer",
+    "ModelPredictor",
+    "ProbabilityPredictor",
+    "ClassPredictor",
+    "AccuracyEvaluator",
+    "F1Evaluator",
+    "LossEvaluator",
     "Model",
     "DATA_AXIS",
     "MODEL_AXIS",

@@ -86,11 +86,24 @@ class DataFrame:
         return self
 
     def split(self, fraction: float, seed: int = 0) -> tuple["DataFrame", "DataFrame"]:
-        """Random train/test split (the notebooks use Spark ``randomSplit``)."""
+        """Random train/test split (two-way shorthand for :meth:`random_split`)."""
+        a, b = self.random_split([fraction, 1.0 - fraction], seed=seed)
+        return a, b
+
+    def random_split(self, weights: Sequence[float], seed: int = 0) -> list["DataFrame"]:
+        """N-way random split by relative ``weights`` — Spark's
+        ``DataFrame.randomSplit([0.8, 0.2])``, so reference notebooks port
+        without rewriting their split calls."""
+        w = np.asarray(weights, dtype=np.float64)
+        if len(w) < 1 or (w <= 0).any():
+            raise ValueError(f"weights must be positive, got {list(weights)}")
         rng = np.random.default_rng(seed)
         idx = rng.permutation(self._num_rows)
-        cut = int(self._num_rows * fraction)
-        return self.take_rows(idx[:cut]), self.take_rows(idx[cut:])
+        cuts = np.floor(np.cumsum(w / w.sum()) * self._num_rows).astype(int)
+        return [self.take_rows(part) for part in np.split(idx, cuts[:-1])]
+
+    #: Spark-spelled alias (the notebooks call ``df.randomSplit``).
+    randomSplit = random_split
 
     def iter_rows(self) -> Iterator[dict]:
         for i in range(self._num_rows):

@@ -119,3 +119,26 @@ def test_make_batches_stores_one_copy():
     # 50 epochs must not copy the dataset 50x: only indices scale with epochs
     assert plan.x.shape == (96, 4)
     assert plan.index.shape[0] == 3 * 50
+
+
+def test_random_split_spark_parity():
+    import numpy as np
+    from distkeras_tpu.data import DataFrame
+
+    df = DataFrame({"x": np.arange(100, dtype=np.float32)})
+    parts = df.random_split([0.6, 0.2, 0.2], seed=3)
+    assert [len(p) for p in parts] == [60, 20, 20]
+    merged = np.sort(np.concatenate([p["x"] for p in parts]))
+    np.testing.assert_array_equal(merged, np.arange(100))
+    # Spark-spelled alias used by the reference notebooks
+    a, b = df.randomSplit([0.8, 0.2], seed=0)
+    assert len(a) == 80 and len(b) == 20
+
+
+def test_top_level_parity_exports():
+    import distkeras_tpu as dk
+
+    for name in ("MinMaxTransformer", "OneHotTransformer", "ReshapeTransformer",
+                 "LabelIndexTransformer", "DenseTransformer", "ModelPredictor",
+                 "ClassPredictor", "AccuracyEvaluator"):
+        assert hasattr(dk, name), name
