@@ -62,6 +62,11 @@ class Model:
     module: nn.Module
     params: Any
     sample_spec: Any = None
+    #: mutable non-param variable collections, e.g. {"batch_stats": tree} for
+    #: flax BatchNorm models or {"keras_state": [...]} for carried Keras
+    #: non-trainables. None for pure-functional models. Engines thread these
+    #: through training and cross-replica-mean them at each fold.
+    state: Any = None
 
     @classmethod
     def build(
@@ -79,20 +84,35 @@ class Model:
         inputs = sample_input if isinstance(sample_input, tuple) else (sample_input,)
         variables = module.init(jax.random.key(seed), *inputs, train=False)
         params = variables["params"]
+        state = {k: v for k, v in variables.items() if k != "params"} or None
         spec = tuple(jax.ShapeDtypeStruct(jnp.shape(a), jnp.asarray(a).dtype)
                      for a in inputs)
-        return cls(module=module, params=params, sample_spec=spec)
+        return cls(module=module, params=params, sample_spec=spec, state=state)
 
-    def apply(self, params, *inputs, train: bool = False, rng=None):
-        """Pure forward pass — the jit-safe core of ``model.predict``/``train_on_batch``."""
+    def apply(self, params, *inputs, train: bool = False, rng=None, state=None):
+        """Pure forward pass — the jit-safe core of ``model.predict``/``train_on_batch``.
+
+        Inference-mode by default: mutable collections (``state`` or the
+        model's own) are read, never updated.
+        """
         rngs = {"dropout": rng} if rng is not None else None
-        return self.module.apply({"params": params}, *inputs, train=train, rngs=rngs)
+        variables = {"params": params, **((state if state is not None
+                                           else self.state) or {})}
+        return self.module.apply(variables, *inputs, train=train, rngs=rngs)
 
     def predict(self, *inputs):
         return self.apply(self.params, *inputs, train=False)
 
     def with_params(self, params) -> "Model":
         return dataclasses.replace(self, params=params)
+
+    def with_state(self, state) -> "Model":
+        return dataclasses.replace(self, state=state)
+
+    @property
+    def state_collections(self) -> tuple:
+        """Names of the mutable collections (() for pure models)."""
+        return tuple(self.state) if self.state else ()
 
     def reinit_params(self, seed: int):
         """Fresh parameters drawn with a different PRNG key (ensemble diversity).

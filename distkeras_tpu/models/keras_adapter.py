@@ -14,12 +14,17 @@ non-trainable state (BatchNorm running stats, seed generators). Frozen
 non-trainable variables are fine — they ride along as captured constants. That
 covers the reference's 2016-era workloads (Dense/Conv/LSTM stacks).
 
-BatchNorm story: pass ``batchnorm="freeze"`` to ingest BatchNorm-bearing models.
-Freezing puts every BatchNormalization layer in inference mode (Keras semantics
-of ``layer.trainable = False``): it normalizes by its stored moving statistics,
-which ride along as frozen constants. This is the standard fine-tuning treatment
-and the *deterministic* choice for data-parallel training — per-replica running
-stats would otherwise diverge across workers and need their own collective.
+BatchNorm story (two modes):
+
+* ``batchnorm="freeze"`` — every BatchNormalization layer runs in inference
+  mode (Keras semantics of ``layer.trainable = False``): moving statistics are
+  used, never updated, riding along as frozen constants. The standard
+  fine-tuning treatment; fully deterministic.
+* ``batchnorm="carry"`` — the non-trainable variables become the model's
+  mutable *state* (``Model.state["keras_state"]``): the engines thread them
+  through the training window and cross-replica **pmean** them at every fold,
+  so running statistics are a deterministic average across workers instead of
+  the reference's raced socket overwrites. Train-from-scratch semantics.
 """
 
 from __future__ import annotations
@@ -56,13 +61,19 @@ class KerasModuleAdapter:
         self.keras_model = keras_model
         self.non_trainable = non_trainable
 
-    def apply(self, variables, *inputs, train: bool = False, rngs=None, **kw):
+    def apply(self, variables, *inputs, train: bool = False, rngs=None,
+              mutable=False, **kw):
         # rngs ignored: Keras manages dropout seeds via its own seed variables;
-        # models with *stateful* seeds are rejected at ingestion.
+        # models with *stateful* seeds are rejected at ingestion (error mode).
         params = variables["params"]
-        out, _ = self.keras_model.stateless_call(
-            params, self.non_trainable, *inputs, training=train
+        non_trainable = variables.get("keras_state", self.non_trainable)
+        out, nt_after = self.keras_model.stateless_call(
+            params, non_trainable, *inputs, training=train
         )
+        if mutable:
+            # carry mode: hand the updated non-trainables (BatchNorm running
+            # stats) back as the new state collection
+            return out, {"keras_state": list(nt_after)}
         return out
 
     # -- config round-trip for serialize_model -----------------------------
@@ -103,15 +114,16 @@ def from_keras(keras_model, sample_input=None, batchnorm: str = "error") -> Mode
     right trailing dims).
 
     ``batchnorm``: ``"error"`` (default) rejects models whose forward pass
-    updates non-trainable state; ``"freeze"`` sets every BatchNormalization
-    layer ``trainable = False`` first — Keras then runs it in inference mode
-    (moving statistics used, never updated), making the model pure and
-    ingestable. See the module docstring for why freezing is the right
-    data-parallel semantics.
+    updates non-trainable state; ``"freeze"`` runs every BatchNormalization
+    layer in inference mode (pure, deterministic — the fine-tuning treatment);
+    ``"carry"`` threads the non-trainables through training as mutable model
+    state, cross-replica-averaged at every fold (train-from-scratch BN). See
+    the module docstring.
     """
     keras = _keras()
-    if batchnorm not in ("error", "freeze"):
-        raise ValueError(f"batchnorm must be 'error' or 'freeze', got {batchnorm!r}")
+    if batchnorm not in ("error", "freeze", "carry"):
+        raise ValueError(
+            f"batchnorm must be 'error', 'freeze' or 'carry', got {batchnorm!r}")
     if not keras_model.built:
         if sample_input is None:
             raise ValueError("model is unbuilt; pass sample_input to build it")
@@ -125,8 +137,27 @@ def from_keras(keras_model, sample_input=None, batchnorm: str = "error") -> Mode
     non_trainable = [
         jax.numpy.asarray(v.value) for v in keras_model.non_trainable_variables
     ]
-    # Reject models whose forward pass mutates non-trainable state: our engines
-    # carry only `params`, so silent staleness would result.
+    if batchnorm == "carry":
+        # Carried state is cross-replica pmean'd by the engines — meaningful
+        # for float statistics (BatchNorm moving mean/var), meaningless and
+        # corrupting for stateful integer seeds (Dropout's SeedGenerator:
+        # averaged uint32 seed state is garbage and float division changes its
+        # dtype). Reject those up front.
+        for v, raw in zip(non_trainable, keras_model.non_trainable_variables):
+            if not jax.numpy.issubdtype(v.dtype, jax.numpy.floating):
+                raise ValueError(
+                    f"batchnorm='carry' cannot carry non-float non-trainable "
+                    f"state ({raw.path}: {v.dtype}) — stateful seed layers "
+                    "(Dropout etc.) don't average across replicas. Use "
+                    "batchnorm='freeze', or drop the stateful layers."
+                )
+        module = KerasModuleAdapter(keras_model, non_trainable)
+        return Model(
+            module=module, params=trainable,
+            state={"keras_state": non_trainable} if non_trainable else None,
+        )
+    # error/freeze: reject models whose forward pass mutates non-trainable
+    # state — without carried state, silent staleness would result.
     if non_trainable and sample_input is not None:
         _, nt_after = keras_model.stateless_call(
             trainable, non_trainable, np.asarray(sample_input), training=True

@@ -59,6 +59,11 @@ class Discipline:
     #: pull-based disciplines start every round from the center variable; elastic
     #: ones keep a persistent local replica.
     pulls_center: bool = True
+    #: whether mutable model state (BatchNorm running stats) is cross-worker
+    #: pmean'd at each fold. Communicating disciplines sync it; the no-comm
+    #: ensemble fold keeps each member's statistics independent (they must
+    #: match that member's own params).
+    syncs_state: bool = True
 
     def init_state(self, params) -> Any:
         return ()
@@ -168,6 +173,7 @@ class EnsembleFold(Discipline):
     (reference ``EnsembleTrainer`` / the per-worker phase of ``AveragingTrainer``)."""
 
     pulls_center = False
+    syncs_state = False
 
     def fold(self, center, local, fold_state, *, axis_name, window, num_workers):
         return FoldResult(center, local, fold_state)

@@ -46,6 +46,12 @@ class EngineState(NamedTuple):
     opt_state: Any
     fold_state: Any
     rng: jax.Array
+    #: mutable model collections (BatchNorm stats; None for pure models),
+    #: stacked ``[W, ...]`` and sharded on the worker axis like ``locals_``.
+    #: Communicating disciplines pmean them at each fold (running statistics
+    #: become a deterministic average, not the reference's raced socket
+    #: overwrite); the no-comm ensemble fold keeps them per-member.
+    model_state: Any = None
 
 
 def _stack_for_workers(tree, num_workers: int):
@@ -78,7 +84,8 @@ class AsyncEngine:
         self.tx = get_optimizer(optimizer, learning_rate)
         self.loss_fn = get_loss(loss)
         self._local_loop = make_local_loop(
-            model.module, self.loss_fn, self.tx, compute_dtype=compute_dtype
+            model.module, self.loss_fn, self.tx, compute_dtype=compute_dtype,
+            state_collections=model.state_collections,
         )
         self._multi_fns = {}
         self._round_fn = self._build_round_fn()
@@ -90,15 +97,23 @@ class AsyncEngine:
         num_workers = self.num_workers
         local_loop = self._local_loop
 
-        def body(center, locals_, opt_state, fold_state, rng, xs, ys):
+        def body(center, locals_, opt_state, fold_state, rng, model_state, xs, ys):
             # Inside shard_map: leading worker axis is 1 on this slice.
             local = jax.tree.map(lambda a: jnp.squeeze(a, 0), locals_)
             opt = jax.tree.map(lambda a: jnp.squeeze(a, 0), opt_state)
+            mstate = jax.tree.map(lambda a: jnp.squeeze(a, 0), model_state)
             xs0, ys0 = xs[0], ys[0]  # [K, B, ...]
 
             start = center if disc.pulls_center else local
             worker_rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
-            new_local, new_opt, losses = local_loop(start, opt, xs0, ys0, worker_rng)
+            new_local, new_opt, mstate, losses = local_loop(
+                start, opt, xs0, ys0, worker_rng, mstate)
+            if disc.syncs_state:
+                # Stats fold: cross-worker mean (running statistics average;
+                # they are not gradient-like deltas). Ensemble members keep
+                # their own stats — each must match its own params.
+                mstate = lax.pmean(mstate, DATA_AXIS)
+            model_state = jax.tree.map(lambda a: a[None], mstate)
 
             new_center, new_local, new_fold_state = disc.fold(
                 center, new_local, fold_state,
@@ -116,23 +131,27 @@ class AsyncEngine:
                 jax.tree.map(lambda a: a[None], new_opt),
                 new_fold_state,
                 next_rng,
+                model_state,
                 loss,
             )
 
         mapped = shard_map(
             body,
             mesh=self.mesh,
-            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS)),
+            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS),
+                      P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS),
+                       P(DATA_AXIS)),
             check_vma=False,
         )
 
         def round_fn(state: EngineState, xs, ys):
-            center, locals_, opt_state, fold_state, rng, loss = mapped(
+            center, locals_, opt_state, fold_state, rng, model_state, loss = mapped(
                 state.center, state.locals_, state.opt_state, state.fold_state,
-                state.rng, xs, ys,
+                state.rng, state.model_state, xs, ys,
             )
-            return EngineState(center, locals_, opt_state, fold_state, rng), loss
+            return EngineState(center, locals_, opt_state, fold_state, rng,
+                               model_state), loss
 
         self._round_core = round_fn
         return jax.jit(round_fn, donate_argnums=(0,))
@@ -170,12 +189,15 @@ class AsyncEngine:
 
         rep = NamedSharding(self.mesh, P())
         shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        model_state = _stack_for_workers(
+            jax.tree.map(lambda a: jnp.asarray(np.array(a)), self.model.state), W)
         return EngineState(
             center=put_global(center, rep),
             locals_=put_global(locals_, shard),
             opt_state=put_global(opt_state, shard),
             fold_state=put_global(fold_state, rep),
             rng=put_global(rng, rep),
+            model_state=put_global(model_state, shard),
         )
 
     def _put_batch(self, xs: np.ndarray, ys: np.ndarray):

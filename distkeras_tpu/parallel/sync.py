@@ -33,6 +33,9 @@ class SyncState(NamedTuple):
     params: Any
     opt_state: Any
     rng: jax.Array
+    #: mutable model collections (BatchNorm stats; None for pure models),
+    #: replicated — re-synced by pmean after every round.
+    model_state: Any = None
 
 
 class SyncEngine:
@@ -64,32 +67,38 @@ class SyncEngine:
         local_loop = make_local_loop(
             self.model.module, self.loss_fn, self.tx,
             compute_dtype=self.compute_dtype, grad_transform=sync_grads,
+            state_collections=self.model.state_collections,
         )
 
-        def body(params, opt_state, rng, xs, ys):
+        def body(params, opt_state, rng, model_state, xs, ys):
             # xs: [1, K, B/W, ...] on this slice — same worker-major layout as the
             # async engine, so one BatchPlan serves both engines.
             xs0, ys0 = xs[0], ys[0]
             # Per-replica dropout stream; the *carried* rng stays replicated (the
             # divergent key never leaves the local loop).
             step_rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
-            params, opt_state, losses = local_loop(params, opt_state, xs0, ys0, step_rng)
+            params, opt_state, model_state, losses = local_loop(
+                params, opt_state, xs0, ys0, step_rng, model_state)
+            # Running statistics re-sync: each replica saw its own batch slice;
+            # the mean is the canonical cross-replica estimate (params need no
+            # such sync — the per-step gradient pmean keeps them identical).
+            model_state = lax.pmean(model_state, DATA_AXIS)
             next_rng = jax.random.split(rng, 1)[0]
-            return params, opt_state, next_rng, losses
+            return params, opt_state, next_rng, model_state, losses
 
         mapped = shard_map(
             body,
             mesh=self.mesh,
-            in_specs=(P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=(P(), P(), P(), P()),
+            in_specs=(P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(), P(), P(), P(), P()),
             check_vma=False,
         )
 
         def round_fn(state: SyncState, xs, ys):
-            params, opt_state, rng, losses = mapped(
-                state.params, state.opt_state, state.rng, xs, ys
+            params, opt_state, rng, model_state, losses = mapped(
+                state.params, state.opt_state, state.rng, state.model_state, xs, ys
             )
-            return SyncState(params, opt_state, rng), jnp.mean(losses)
+            return SyncState(params, opt_state, rng, model_state), jnp.mean(losses)
 
         self._round_core = round_fn
         return jax.jit(round_fn, donate_argnums=(0,))
@@ -109,10 +118,12 @@ class SyncEngine:
         rep = NamedSharding(self.mesh, P())
         # Deep-copy: round_fn donates its input state; never alias the user's Model.
         params = jax.tree.map(lambda a: np.array(a), self.model.params)
+        model_state = jax.tree.map(lambda a: np.array(a), self.model.state)
         return SyncState(
             params=put_global(params, rep),
             opt_state=put_global(self.tx.init(params), rep),
             rng=put_global(jax.random.key(self.seed), rep),
+            model_state=put_global(model_state, rep),
         )
 
     def run(

@@ -54,11 +54,14 @@ class ModelPredictor(Predictor):
         # out_shardings=replicated: the gathered predictions are fully
         # addressable on every process (multi-host predict works; one small
         # all-gather per chunk otherwise fused away single-process).
+        state = self.model.state or {}
         self._fwd = jax.jit(
-            lambda params, x: self.model.module.apply({"params": params}, x, train=False),
+            lambda params, state, x: self.model.module.apply(
+                {"params": params, **state}, x, train=False),
             out_shardings=rep,
         )
         self._params = put_global(self.model.params, rep)
+        self._state = put_global(state, rep)
         self._shard = NamedSharding(self.mesh, P(DATA_AXIS))
 
     def _postprocess(self, out: np.ndarray) -> np.ndarray:
@@ -77,7 +80,7 @@ class ModelPredictor(Predictor):
             if pad:
                 chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad, axis=0)])
             xb = put_global(np.asarray(chunk), self._shard)
-            out = np.asarray(self._fwd(self._params, xb))
+            out = np.asarray(self._fwd(self._params, self._state, xb))
             outs.append(out[: len(out) - pad] if pad else out)
         return self._postprocess(np.concatenate(outs, axis=0))
 

@@ -53,11 +53,16 @@ def deserialize_params(target: Any, data: bytes) -> Any:
 
 
 def serialize_model(model) -> bytes:
-    """A ``Model`` -> self-describing bytes (architecture spec + weights)."""
+    """A ``Model`` -> self-describing bytes (architecture spec + weights).
+
+    Format v2 packs ``{"params", "state"}`` so stateful models (carried
+    BatchNorm statistics) round-trip; v1 blobs (params-only) still load.
+    """
     spec = dict(model.spec())
-    spec["format_version"] = 1
+    spec["format_version"] = 2
     spec_bytes = json.dumps(spec).encode("utf-8")
-    payload = flax_ser.to_bytes(model.params)
+    payload = flax_ser.to_bytes(
+        {"params": model.params, "state": getattr(model, "state", None) or {}})
     return MAGIC + struct.pack("<I", len(spec_bytes)) + spec_bytes + payload
 
 
@@ -74,9 +79,15 @@ def deserialize_model(data: bytes):
     off += spec_len
     cls = get_model_class(spec["class"])
     module = cls.from_config(spec["kwargs"])
-    params = flax_ser.msgpack_restore(data[off:])
+    restored = flax_ser.msgpack_restore(data[off:])
+    if spec.get("format_version", 1) >= 2:
+        params, state = restored["params"], restored["state"] or None
+    else:
+        params, state = restored, None
     # msgpack round-trips lists as {'0': ..., '1': ...} dicts; modules that use
     # list-shaped params (e.g. the Keras adapter) restore the structure here.
     if hasattr(module, "fix_params_structure"):
         params = module.fix_params_structure(params)
-    return Model(module=module, params=params)
+        if state is not None:
+            state = {k: module.fix_params_structure(v) for k, v in state.items()}
+    return Model(module=module, params=params, state=state)

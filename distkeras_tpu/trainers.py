@@ -215,6 +215,24 @@ class Trainer:
             self.history = losses
         return state
 
+    def _finish_model(self, params, engine_state, worker: Optional[int] = None,
+                      state_reduce=None) -> Model:
+        """Model with trained params + (if the model is stateful) the trained
+        mutable collections (BatchNorm running stats).
+
+        Async engines stack state ``[W, ...]``: pass ``worker`` to take one
+        member's copy (synced disciplines keep all copies equal, so 0 is
+        canonical) or ``state_reduce`` to aggregate (AveragingTrainer)."""
+        m = self.model.with_params(params)
+        trained_state = getattr(engine_state, "model_state", None)
+        if trained_state is not None:
+            if state_reduce is not None:
+                trained_state = jax.tree.map(state_reduce, trained_state)
+            elif worker is not None:
+                trained_state = jax.tree.map(lambda a: a[worker], trained_state)
+            m = m.with_state(jax.tree.map(np.asarray, trained_state))
+        return m
+
     # -- timing parity (reference Trainer.record_training_start/stop) -------
     def record_training_start(self):
         self._t_start = time.perf_counter()
@@ -261,7 +279,7 @@ class SingleTrainer(Trainer):
         )
         state = self._execute(engine, plan)
         self.record_training_stop()
-        return self.model.with_params(state.params)
+        return self._finish_model(state.params, state)
 
 
 class DistributedTrainer(Trainer):
@@ -300,7 +318,7 @@ class SynchronousDistributedTrainer(DistributedTrainer):
         )
         state = self._execute(engine, plan)
         self.record_training_stop()
-        return self.model.with_params(state.params)
+        return self._finish_model(state.params, state)
 
 
 class AsynchronousDistributedTrainer(DistributedTrainer):
@@ -334,7 +352,7 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         self.record_training_start()
         state = self._run(dataframe, shuffle)
         self.record_training_stop()
-        return self.model.with_params(state.center)
+        return self._finish_model(state.center, state, worker=0)
 
 
 class DOWNPOUR(AsynchronousDistributedTrainer):
@@ -434,7 +452,8 @@ class AveragingTrainer(DistributedTrainer):
         state = self._execute(engine, plan)
         averaged = jax.tree.map(lambda a: jnp.mean(a, axis=0), state.locals_)
         self.record_training_stop()
-        return self.model.with_params(averaged)
+        return self._finish_model(averaged, state,
+                                  state_reduce=lambda a: jnp.mean(a, axis=0))
 
 
 class EnsembleTrainer(DistributedTrainer):
@@ -466,5 +485,5 @@ class EnsembleTrainer(DistributedTrainer):
         models = []
         for i in range(engine.num_workers):
             params_i = jax.tree.map(lambda a: a[i], stacked)
-            models.append(self.model.with_params(params_i))
+            models.append(self._finish_model(params_i, state, worker=i))
         return models

@@ -16,7 +16,7 @@ the sync engine injects a per-step gradient ``pmean`` via ``grad_transform``.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -30,53 +30,75 @@ def make_local_loop(
     tx: optax.GradientTransformation,
     compute_dtype=None,
     grad_transform: Optional[Callable] = None,
+    state_collections: Sequence[str] = (),
 ):
-    """Build ``local_steps(params, opt_state, xs, ys, rng) -> (params, opt_state, losses)``.
+    """Build ``local_steps(params, opt_state, xs, ys, rng, state) ->
+    (params, opt_state, state, losses)``.
 
-    ``xs``/``ys`` are ``[window, batch, ...]``; the scan carries (params, opt_state)
-    across the window — the executor minibatch loop with zero host round-trips.
-    With a ``compute_dtype``, both inputs *and* params are cast to it inside the
-    loss (canonical mixed precision: fwd/bwd run entirely at the MXU's bf16 rate,
-    while the carried master params, gradients, and optimizer state stay float32 —
-    the cast's cotangent upcasts the grads). Casting inputs alone promotes every
-    matmul/conv back to float32 and halves MXU throughput (measured: CIFAR-10 CNN
-    30 -> 46 TFLOPS/chip on v5e from casting params too). ``grad_transform(grads,
+    ``xs``/``ys`` are ``[window, batch, ...]``; the scan carries (params, opt_state,
+    state) across the window — the executor minibatch loop with zero host
+    round-trips. With a ``compute_dtype``, both inputs *and* params are cast to it
+    inside the loss (canonical mixed precision: fwd/bwd run entirely at the MXU's
+    bf16 rate, while the carried master params, gradients, and optimizer state stay
+    float32 — the cast's cotangent upcasts the grads). Casting inputs alone promotes
+    every matmul/conv back to float32 and halves MXU throughput (measured: CIFAR-10
+    CNN 30 -> 46 TFLOPS/chip on v5e from casting params too). ``grad_transform(grads,
     loss) -> (grads, loss)`` runs after each backward pass — the sync engine's
     gradient all-reduce hook.
+
+    ``state_collections`` names the model's mutable variable collections
+    (BatchNorm running stats: flax ``batch_stats`` / the Keras adapter's
+    ``keras_state``); ``state`` is the matching ``{collection: tree}`` dict (or
+    None for stateless models). The forward runs with those collections mutable
+    and the updated state is carried across the window — the engines
+    cross-replica-mean it at each fold (see AsyncEngine/SyncEngine). State is
+    deliberately NOT cast to ``compute_dtype`` — running statistics stay in
+    their stored precision.
 
     The rng handed in must be identical across replicas if determinism across
     restarts matters; per-step dropout keys are derived inside the scan.
     """
+    cols = tuple(state_collections or ())
 
     def cast(x):
         if compute_dtype is not None and jnp.issubdtype(x.dtype, jnp.floating):
             return x.astype(compute_dtype)
         return x
 
-    def loss_on_batch(params, x, y, rng):
+    def loss_on_batch(params, state, x, y, rng):
         if compute_dtype is not None:
             params = jax.tree.map(cast, params)
         # Always provide a dropout rng: harmless for dropout-free modules, required
         # for any module that samples (flax raises at trace time otherwise).
+        if cols:
+            out, mut = module.apply(
+                {"params": params, **state}, cast(x), train=True,
+                rngs={"dropout": rng}, mutable=list(cols),
+            )
+            new_state = {k: mut[k] for k in cols}
+            return loss_fn(out.astype(jnp.float32), y), new_state
         out = module.apply({"params": params}, cast(x), train=True, rngs={"dropout": rng})
-        return loss_fn(out.astype(jnp.float32), y)
+        return loss_fn(out.astype(jnp.float32), y), state
 
-    def local_steps(params, opt_state, xs, ys, rng: Optional[jax.Array] = None):
+    def local_steps(params, opt_state, xs, ys, rng: Optional[jax.Array] = None,
+                    state=None):
         if rng is None:
             rng = jax.random.key(0)
 
         def step(carry, batch):
-            p, s, key = carry
+            p, s, st, key = carry
             key, sub = jax.random.split(key)
             x, y = batch
-            loss, grads = jax.value_and_grad(loss_on_batch)(p, x, y, sub)
+            (loss, st), grads = jax.value_and_grad(loss_on_batch, has_aux=True)(
+                p, st, x, y, sub)
             if grad_transform is not None:
                 grads, loss = grad_transform(grads, loss)
             updates, s = tx.update(grads, s, p)
             p = optax.apply_updates(p, updates)
-            return (p, s, key), loss
+            return (p, s, st, key), loss
 
-        (params, opt_state, _), losses = lax.scan(step, (params, opt_state, rng), (xs, ys))
-        return params, opt_state, losses
+        (params, opt_state, state, _), losses = lax.scan(
+            step, (params, opt_state, state, rng), (xs, ys))
+        return params, opt_state, state, losses
 
     return local_steps
