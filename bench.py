@@ -161,7 +161,7 @@ def _bench_engine(engine, plan, warmup: int, timed: int, rounds_per_program: int
 
 def _measure(name, model_fn, discipline, batch_size, window, sample_shape,
              num_classes, timed=30, warmup=3, int_inputs=False, vocab=None,
-             optimizer="sgd", rounds_per_program=1):
+             optimizer="sgd", rounds_per_program=1, num_workers=None):
     """Build engine+plan for one config and measure it."""
     import jax
 
@@ -196,7 +196,7 @@ def _measure(name, model_fn, discipline, batch_size, window, sample_shape,
         x = rng.random(size=(n,) + sample_shape, dtype=np.float32)
     y = rng.integers(0, num_classes, size=n).astype(np.int32)
     df = DataFrame({"features": x, "label": y})
-    mesh = data_mesh(num_workers=1 if discipline == "single" else None)
+    mesh = data_mesh(num_workers=1 if discipline == "single" else num_workers)
     workers = mesh.shape["data"]
     plan = make_batches(df, "features", "label", batch_size,
                         num_workers=workers, window=window, num_epoch=1)
@@ -212,7 +212,9 @@ def _measure(name, model_fn, discipline, batch_size, window, sample_shape,
     elapsed = _bench_engine(engine, plan, warmup, timed,
                             rounds_per_program=rounds_per_program)
     samples = timed * workers * window * batch_size
-    sps_chip = samples / elapsed / num_chips
+    # per chip IN USE (== all visible chips for the standard configs; the
+    # scaling sweep pins smaller worker counts)
+    sps_chip = samples / elapsed / workers
     tflops = None
     mfu = None
     # Off-TPU the models may be swapped for tiny stand-ins (see resnet50_sync)
@@ -310,8 +312,59 @@ def _measure_spmd_transformer(name, *, num_layers, d_model, num_heads, d_ff,
     return rec
 
 
+def scaling_sweep():
+    """The north-star gate's measurement machinery (BASELINE.md #3): CIFAR-10
+    CNN under AEASGD at num_workers = 1, 2, 4, ..., N over the visible devices,
+    reporting total samples/s and scaling efficiency vs the 1-worker run
+    (``metrics.scaling_efficiency``). On a pod this sweeps real chips; run
+    with ``BENCH_SCALING=1``. Prints its own single JSON line and exits."""
+    import jax
+
+    from distkeras_tpu.metrics import scaling_efficiency
+    from distkeras_tpu.models.cnn import cifar10_cnn
+
+    on_tpu = jax.default_backend() == "tpu"
+    n = jax.device_count()
+    ws, w = [], 1
+    while w <= n:
+        ws.append(w)
+        w *= 2
+    if ws[-1] != n:
+        ws.append(n)  # always measure the full visible device count
+    points = []
+    base_per_chip = None
+    for w in ws:
+        rec = _measure("cifar10_cnn_aeasgd", cifar10_cnn, "aeasgd",
+                       batch_size=1024 if on_tpu else 16, window=8,
+                       sample_shape=(32, 32, 3), num_classes=10,
+                       timed=8 if on_tpu else 2,
+                       rounds_per_program=2 if on_tpu else 1, num_workers=w)
+        per_chip = rec["value"]
+        total = per_chip * w
+        if base_per_chip is None:
+            base_per_chip = per_chip
+        points.append({
+            "num_workers": w,
+            "samples_per_sec_total": round(total, 1),
+            "scaling_efficiency": round(
+                scaling_efficiency(total, base_per_chip, w), 4),
+        })
+    out = {
+        "metric": "cifar10_cnn_aeasgd_scaling_efficiency",
+        "value": points[-1]["scaling_efficiency"],
+        "unit": "ratio (throughput(N) / (N x throughput(1)))",
+        "vs_baseline": round(points[-1]["scaling_efficiency"] / 0.90, 3),
+        "points": points,
+    }
+    print(json.dumps(out))
+
+
 def main():
     import jax
+
+    if os.environ.get("BENCH_SCALING") not in (None, "", "0"):
+        scaling_sweep()
+        return
 
     from distkeras_tpu.models.cnn import cifar10_cnn, mnist_cnn
     from distkeras_tpu.models.lstm import imdb_lstm

@@ -16,6 +16,17 @@ from distkeras_tpu.runtime.serialization import (
 )
 
 
+def set_keras_base_directory(path: str = ".") -> None:
+    """Reference parity (``distkeras/utils.py -> set_keras_base_directory``):
+    pointed 2016-era Keras at a writable ``~/.keras`` on Spark executors. No
+    TPU equivalent is needed — models are pure pytrees, nothing touches a
+    Keras home directory — but ported notebooks may still call it, so it
+    accepts the call and points Keras-3's home at ``<path>/.keras``."""
+    import os
+
+    os.environ["KERAS_HOME"] = os.path.join(path, ".keras")
+
+
 def serialize_keras_model(model: Model) -> bytes:
     """Reference ``utils.serialize_keras_model``: model -> portable bytes."""
     return serialize_model(model)
