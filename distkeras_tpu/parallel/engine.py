@@ -119,11 +119,12 @@ class AsyncEngine:
                 center, new_local, fold_state,
                 axis_name=DATA_AXIS, window=window, num_workers=num_workers,
             )
-            # Per-worker window-mean loss, gathered on the worker axis: the
-            # global result is [W] — the per-worker training histories the
-            # reference optionally collected on the driver (SURVEY.md §5
-            # metrics row). The global loss is their mean (equal batch sizes).
-            loss = jnp.mean(losses)[None]
+            # Per-worker window-mean loss, all-gathered so the [W] history
+            # vector is REPLICATED (fully addressable on every process of a
+            # multi-host mesh — a data-sharded loss can't be fetched on the
+            # driver). These are the per-worker training histories the
+            # reference optionally collected (SURVEY.md §5 metrics row).
+            loss = lax.all_gather(jnp.mean(losses), DATA_AXIS)
             next_rng = jax.random.split(rng, 1)[0]
             return (
                 new_center,
@@ -133,7 +134,7 @@ class AsyncEngine:
                 next_rng,
                 model_state,
                 loss,
-            )
+            )  # loss: replicated [W]
 
         mapped = shard_map(
             body,
@@ -141,7 +142,7 @@ class AsyncEngine:
             in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS),
                       P(DATA_AXIS), P(DATA_AXIS)),
             out_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS),
-                       P(DATA_AXIS)),
+                       P()),
             check_vma=False,
         )
 

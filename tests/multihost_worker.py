@@ -69,8 +69,8 @@ def main() -> None:
         if die_at is not None and process_id == die_proc and r == int(die_at):
             os._exit(17)
 
-    trainer = SynchronousDistributedTrainer(
-        model, loss="sparse_categorical_crossentropy",
+    common = dict(
+        loss="sparse_categorical_crossentropy",
         num_workers=jax.device_count(),  # the full global mesh, both processes
         batch_size=16, num_epoch=2, learning_rate=0.1,
         checkpoint_dir=os.environ.get("DK_CKPT_DIR") or None,
@@ -78,6 +78,15 @@ def main() -> None:
         resume=os.environ.get("DK_RESUME") == "1",
         on_round=fault,
     )
+    # DK_TRAINER selects the discipline: "sync" (default) exercises the
+    # per-step-pmean path, "adag" the async center-variable fold — both must
+    # work across a multi-process DCN mesh.
+    if os.environ.get("DK_TRAINER") == "adag":
+        from distkeras_tpu import ADAG
+
+        trainer = ADAG(model, communication_window=4, **common)
+    else:
+        trainer = SynchronousDistributedTrainer(model, **common)
     trained = trainer.train(df)
 
     logits = np.asarray(trained.predict(np.asarray(x, np.float32)))

@@ -11,7 +11,6 @@ distributed-backend row; BASELINE config #5's pod story).
 import json
 import os
 import socket
-import subprocess
 
 import pytest
 
@@ -27,42 +26,49 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_sync_dp_over_loopback(tmp_path):
-    hosts = ["localhost", "localhost"]
+def _launch_job(out_dir, extra_env, timeout, job_name="pytest-multihost",
+                devices_per_proc=2):
+    """Shared 2-process launch: build the Punchcard, launch through Job, and
+    supervise to completion (teardown on first failure or timeout)."""
     card = Punchcard(
-        job_name="pytest-2proc-syncdp",
+        job_name=job_name,
         script=_WORKER,
-        hosts=hosts,
+        hosts=["localhost", "localhost"],
         coordinator_port=_free_port(),
         env={
             "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "XLA_FLAGS": f"--xla_force_host_platform_device_count={devices_per_proc}",
             "KERAS_BACKEND": "jax",
-            "DK_OUT": str(tmp_path),
+            "DK_OUT": str(out_dir),
             "PYTHONPATH": _REPO,
+            **extra_env,
         },
     )
     job = Job(card)
+    job.launch(dry_run=False)
+    return job, job.supervise(timeout=timeout)
 
+
+def _read_results(out_dir, n=2):
+    results = []
+    for i in range(n):
+        with open(out_dir / f"proc{i}.json") as f:
+            results.append(json.load(f))
+    return results
+
+
+@pytest.mark.slow
+def test_two_process_sync_dp_over_loopback(tmp_path):
+    job, rcs = _launch_job(tmp_path, {}, timeout=600,
+                           job_name="pytest-2proc-syncdp")
     # The rendered commands are exactly what a pod launch would ssh out.
     cmds = job.render_commands()
     assert len(cmds) == 2
     assert "JAX_PROCESS_ID=0" in cmds[0] and "JAX_PROCESS_ID=1" in cmds[1]
-    assert f"JAX_NUM_PROCESSES={len(hosts)}" in cmds[0]
-
-    job.launch(dry_run=False)
-    try:
-        rcs = job.wait(timeout=600)
-    except subprocess.TimeoutExpired:
-        job.kill()
-        pytest.fail("2-process job did not finish within timeout")
+    assert "JAX_NUM_PROCESSES=2" in cmds[0]
     assert rcs == [0, 0], f"worker processes failed: rcs={rcs}"
 
-    results = []
-    for i in range(2):
-        with open(tmp_path / f"proc{i}.json") as f:
-            results.append(json.load(f))
+    results = _read_results(tmp_path)
 
     for r in results:
         assert r["process_count"] == 2
@@ -77,32 +83,29 @@ def test_two_process_sync_dp_over_loopback(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_async_discipline(tmp_path):
+    """ADAG (async center-variable fold) across 2 processes: the stacked
+    worker state and the fold's psum must behave identically over DCN."""
+    _job, rcs = _launch_job(tmp_path, {"DK_TRAINER": "adag"}, timeout=600,
+                            job_name="pytest-2proc-adag")
+    assert rcs == [0, 0], f"worker processes failed: rcs={rcs}"
+    results = _read_results(tmp_path)
+    for r in results:
+        assert r["accuracy"] > 0.85, r
+    assert results[0]["history"] == pytest.approx(results[1]["history"], rel=1e-6)
+
+
+@pytest.mark.slow
 def test_fault_injection_checkpoint_recovery(tmp_path):
     """Kill one host mid-training (hard abort, no cleanup — a preempted pod
     host), then relaunch the job with resume: the recovered run must finish
     and match an uninterrupted run's final model exactly. This is the
     elastic-recovery story SURVEY.md §5 prescribes (checkpoint-restore over
     Orbax; the cluster manager relaunches, jax.distributed re-assembles)."""
-    base_env = {
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-        "KERAS_BACKEND": "jax",
-        "PYTHONPATH": _REPO,
-    }
-
     def launch(out_dir, extra_env, timeout):
-        card = Punchcard(
-            job_name="pytest-faulttest",
-            script=_WORKER,
-            hosts=["localhost", "localhost"],
-            coordinator_port=_free_port(),
-            env={**base_env, "DK_OUT": str(out_dir), **extra_env},
-        )
-        job = Job(card)
-        job.launch(dry_run=False)
-        # Cluster-manager behavior: on the first failed host, grace then
-        # teardown (no need to sit out the full timeout).
-        return job.supervise(timeout=timeout)
+        _job, rcs = _launch_job(out_dir, extra_env, timeout,
+                                job_name="pytest-faulttest")
+        return rcs
 
     ckpt = tmp_path / "ckpt"
 
