@@ -1,9 +1,12 @@
 """Mixture-of-Experts transformer: expert parallelism over an ``expert`` mesh axis.
 
-Beyond-reference surface (SURVEY.md §2: EP/MoE absent). Switch-style top-1 routing
-with static capacity: dispatch/combine are one-hot einsums (fully differentiable,
-static shapes — XLA-friendly), expert FFNs are a ``nn.vmap``-stacked bank whose
-leading axis carries the expert id. Expert parallelism is GSPMD-style: shard the
+Beyond-reference surface (SURVEY.md §2: EP/MoE absent). Top-k routing with
+static capacity — ``num_selected=1`` is Switch (gate = the winning prob),
+``num_selected=2`` is GShard top-2 (gates renormalized over the selected
+pair; primary selections fill expert queues before secondaries so an
+overflowing expert drops second choices first). Dispatch/combine are one-hot
+einsums (fully differentiable, static shapes — XLA-friendly), expert FFNs are
+a ``nn.vmap``-stacked bank whose leading axis carries the expert id. Expert parallelism is GSPMD-style: shard the
 stacked expert params over the ``expert`` mesh axis (``parallel/sharding.py ->
 MOE_RULES``) and XLA lowers the dispatch/combine einsums into the all-to-alls —
 no hand-written routing collectives to get wrong.
@@ -41,34 +44,54 @@ class MoEMLP(nn.Module):
     d_model: int
     d_ff: int
     capacity_factor: float = 1.5
+    #: experts per token: 1 = Switch (gate = winning prob), 2 = GShard top-2
+    #: (gates renormalized over the pair).
+    num_selected: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         B, L, D = x.shape
         T = B * L
         E = self.num_experts
-        C = max(1, math.ceil(self.capacity_factor * T / E))
+        K = self.num_selected
+        # GShard scales capacity with the selections competing for it: K*T
+        # routes over E queues (K=1 reduces to the Switch formula).
+        C = max(1, math.ceil(self.capacity_factor * K * T / E))
         xf = x.reshape(T, D)
 
         logits = nn.Dense(E, name="router")(xf)
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        gate = probs.max(axis=-1)
-        expert = probs.argmax(axis=-1)
+        topk_probs, topk_idx = jax.lax.top_k(probs, K)  # [T, K]
+        if K == 1:
+            gates = topk_probs  # Switch: the raw winning probability
+        else:
+            gates = topk_probs / topk_probs.sum(axis=-1, keepdims=True)
 
-        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [T, E]
-        # position of each token within its expert's queue; overflow is dropped
-        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
-        keep = (pos < C) * onehot  # [T, E]
-        dispatch = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)
-        dispatch = dispatch * keep[..., None]  # [T, E, C]
-        combine = dispatch * gate[:, None, None]
+        onehot_k = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)  # [T, K, E]
+        # Queue positions, selection-major: all primary (k=0) picks take their
+        # expert-queue slots before any secondary pick, so overflow drops
+        # second choices first (the GShard convention).
+        oh = onehot_k.transpose(1, 0, 2).reshape(K * T, E)
+        pos = (jnp.cumsum(oh, axis=0) - 1.0) * oh
+        keep = (pos < C) * oh  # [K*T, E]
+        disp = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)
+        disp = (disp * keep[..., None]).reshape(K, T, E, C)
+        # Selections are distinct experts per token, so the per-selection
+        # dispatch masks are disjoint: summing merges them losslessly.
+        dispatch = disp.sum(axis=0)  # [T, E, C]
+        combine = (disp * gates.T[:, :, None, None]).sum(axis=0)
 
-        # Switch load-balancing aux loss: E * sum_e (token fraction * prob mass).
-        frac = onehot.mean(axis=0)
+        # Load-balancing aux loss (Switch for K=1; averaged over selections
+        # for K>1): E * sum_e (routed token fraction * mean prob mass).
+        frac = onehot_k.sum(axis=1).mean(axis=0) / K
         prob_mass = probs.mean(axis=0)
         self.sow("intermediates", "aux_loss", E * jnp.sum(frac * prob_mass))
         # Per-expert token fractions, for balance observability/tests.
         self.sow("intermediates", "expert_fraction", frac)
+        # Post-capacity combine mass per token (1.0 = nothing dropped): the
+        # direct observable for capacity pressure.
+        self.sow("intermediates", "combine_mass",
+                 jnp.sum(combine, axis=(1, 2)).mean())
 
         expert_in = jnp.einsum("tec,td->ecd", dispatch, xf.astype(jnp.float32))
         experts = nn.vmap(
@@ -88,6 +111,7 @@ class MoETransformerBlock(nn.Module):
     d_ff: int
     num_experts: int
     capacity_factor: float = 1.5
+    num_selected: int = 1
     seq_axis: Optional[str] = None
     attn_impl: str = "dense"
 
@@ -100,7 +124,8 @@ class MoETransformerBlock(nn.Module):
         x = x + h
         h = nn.LayerNorm(name="ln_mlp")(x)
         h = MoEMLP(self.num_experts, self.d_model, self.d_ff,
-                   capacity_factor=self.capacity_factor, name="moe")(h, train=train)
+                   capacity_factor=self.capacity_factor,
+                   num_selected=self.num_selected, name="moe")(h, train=train)
         return x + h
 
 
@@ -113,6 +138,7 @@ class MoETransformerLM(DKModule):
     d_ff: int = 1024
     num_experts: int = 8
     capacity_factor: float = 1.5
+    num_selected: int = 1
     max_seq_len: int = 2048
     seq_axis: Optional[str] = None
     attn_impl: str = "dense"
@@ -126,7 +152,8 @@ class MoETransformerLM(DKModule):
         for i in range(self.num_layers):
             x = MoETransformerBlock(
                 self.num_heads, self.d_model, self.d_ff, self.num_experts,
-                capacity_factor=self.capacity_factor, seq_axis=self.seq_axis,
+                capacity_factor=self.capacity_factor,
+                num_selected=self.num_selected, seq_axis=self.seq_axis,
                 attn_impl=self.attn_impl, name=f"block_{i}",
             )(x, train=train)
         x = nn.LayerNorm(name="ln_final")(x)
