@@ -23,6 +23,16 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from distkeras_tpu.models.base import DKModule, Model, register_model
+from distkeras_tpu.runtime.mesh import MODEL_AXIS
+
+
+def _axis_is_auto(abstract_mesh, name: str) -> bool:
+    """True if ``name`` is a GSPMD-managed (Auto) axis of the ambient mesh."""
+    try:
+        types = dict(zip(abstract_mesh.axis_names, abstract_mesh.axis_types))
+        return "auto" in str(types[name]).lower()
+    except Exception:
+        return False
 
 
 def _global_positions(local_len: int, seq_axis: Optional[str]) -> jax.Array:
@@ -55,11 +65,31 @@ class CausalSelfAttention(nn.Module):
         elif self.seq_axis is None and self.attn_impl == "flash":
             from distkeras_tpu.ops.pallas import flash_attention
 
-            out = flash_attention(
-                q, k, v,
-                block_size=min(128, L),
-                interpret=jax.default_backend() != "tpu",
-            )
+            def fa(q, k, v):
+                return flash_attention(
+                    q, k, v,
+                    block_size=min(128, L),
+                    interpret=jax.default_backend() != "tpu",
+                )
+
+            # Tensor parallelism: a Mosaic kernel cannot be GSPMD-auto-
+            # partitioned, so when the ambient mesh carries an (auto) model
+            # axis we manualize it locally — each shard runs flash on its own
+            # heads (attention has no cross-head communication). Works inside
+            # the SPMD engine's partially-manual region via nested shard_map.
+            am = jax.sharding.get_abstract_mesh()
+            names = getattr(am, "axis_names", ())
+            if MODEL_AXIS in names and am.shape[MODEL_AXIS] > 1 and (
+                _axis_is_auto(am, MODEL_AXIS)
+            ):
+                from distkeras_tpu.ops.collectives import shard_map
+                from jax.sharding import PartitionSpec as P
+
+                spec = P(None, None, MODEL_AXIS, None)
+                fa = shard_map(fa, mesh=am, in_specs=(spec, spec, spec),
+                               out_specs=spec, axis_names={MODEL_AXIS},
+                               check_vma=False)
+            out = fa(q, k, v)
         else:
             q_pos = _global_positions(L, self.seq_axis)
             if self.seq_axis is not None:
