@@ -59,11 +59,45 @@ class Checkpointer:
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
         )
 
-    def save(self, step: int, state: Any, wait: bool = False) -> None:
-        """Async-save ``state`` (any pytree) at ``step``; ``wait`` blocks."""
+    def save(self, step: int, state: Any, wait: bool = False,
+             meta: Optional[dict] = None) -> None:
+        """Async-save ``state`` (any pytree) at ``step``; ``wait`` blocks.
+
+        ``meta`` (JSON-able; e.g. ``{"num_workers": W}``) lands next to the
+        step so an elastic resume can discover the saved topology.
+        """
         self._mngr.save(step, args=ocp.args.StandardSave(_encode(state)))
+        if meta is not None and jax.process_index() == 0:
+            import json
+
+            meta_dir = os.path.join(self.directory, "meta")
+            os.makedirs(meta_dir, exist_ok=True)
+            tmp = os.path.join(meta_dir, f".{step}.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(meta, f)
+            os.replace(tmp, os.path.join(meta_dir, f"{step}.json"))
+            # GC meta for steps the manager has garbage-collected, so a stale
+            # topology can never be read for a re-used step number.
+            live = {f"{s_}.json" for s_ in self._mngr.all_steps()}
+            for name in os.listdir(meta_dir):
+                if name.endswith(".json") and name not in live:
+                    try:
+                        os.remove(os.path.join(meta_dir, name))
+                    except OSError:
+                        pass
         if wait:
             self._mngr.wait_until_finished()
+
+    def meta(self, step: int) -> Optional[dict]:
+        """The ``meta`` dict saved with ``step`` (None if absent)."""
+        import json
+
+        path = os.path.join(self.directory, "meta", f"{step}.json")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
@@ -78,6 +112,23 @@ class Checkpointer:
         restored = self._mngr.restore(
             step, args=ocp.args.StandardRestore(_abstract(_encode(target)))
         )
+        return jax.tree.map(
+            lambda t, r: jax.random.wrap_key_data(r) if _is_key(t) else r,
+            target, restored,
+        )
+
+    def restore_host(self, target: Any, step: Optional[int] = None) -> Any:
+        """Restore as plain host numpy arrays into ``target``'s *shapes*
+        (shardings ignored) — the raw material for elastic re-topology."""
+        step = self._mngr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        abstract = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
+            if not isinstance(a, jax.ShapeDtypeStruct) else
+            jax.ShapeDtypeStruct(a.shape, a.dtype),
+            _encode(target))
+        restored = self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
         return jax.tree.map(
             lambda t, r: jax.random.wrap_key_data(r) if _is_key(t) else r,
             target, restored,

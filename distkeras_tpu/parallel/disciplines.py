@@ -64,6 +64,10 @@ class Discipline:
     #: ensemble fold keeps each member's statistics independent (they must
     #: match that member's own params).
     syncs_state: bool = True
+    #: whether training progress lives in the center variable (True for every
+    #: communicating fold). The no-comm ensemble fold trains only locals_, so
+    #: pull-the-center elastic resume would discard all learning.
+    center_is_trained: bool = True
 
     def init_state(self, params) -> Any:
         return ()
@@ -174,6 +178,7 @@ class EnsembleFold(Discipline):
 
     pulls_center = False
     syncs_state = False
+    center_is_trained = False
 
     def fold(self, center, local, fold_state, *, axis_name, window, num_workers):
         return FoldResult(center, local, fold_state)

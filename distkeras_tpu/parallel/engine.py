@@ -201,6 +201,60 @@ class AsyncEngine:
             model_state=put_global(model_state, shard),
         )
 
+    def host_state(self, num_workers: int) -> EngineState:
+        """An abstract EngineState template (ShapeDtypeStructs; real key for
+        rng) with ``num_workers``-stacked per-worker arrays — the restore
+        target for a checkpoint written at a different topology. Only shapes
+        are allocated host-side; the restore itself still materializes the
+        full saved tree (Orbax restores whole structures)."""
+        W = num_workers
+
+        def sds(a, lead=()):
+            return jax.ShapeDtypeStruct(
+                tuple(lead) + tuple(np.shape(a)), np.asarray(a).dtype)
+
+        center = jax.tree.map(sds, self.model.params)
+        locals_ = jax.tree.map(lambda a: sds(a, (W,)), self.model.params)
+        zero_params = jax.tree.map(
+            lambda a: jnp.zeros(a.shape, a.dtype), center)
+        opt_state = jax.tree.map(
+            lambda a: sds(a, (W,)), self.tx.init(zero_params))
+        model_state = jax.tree.map(
+            lambda a: sds(a, (W,)), self.model.state)
+        return EngineState(
+            center=center,
+            locals_=locals_,
+            opt_state=opt_state,
+            fold_state=self.discipline.init_state(center),
+            rng=jax.random.key(self.seed),
+            model_state=model_state,
+        )
+
+    def adopt_state(self, host: EngineState) -> EngineState:
+        """Re-topologize a restored host state onto THIS mesh (elastic
+        resume after a pod resize). Reference semantics: a (re)joining worker
+        pulls the center variable — so every replica restarts from the
+        restored center with a fresh optimizer; running statistics are the
+        cross-worker mean of the saved ones. Center, fold state, and rng
+        carry over exactly."""
+        W = self.num_workers
+        rep = NamedSharding(self.mesh, P())
+        shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        center = jax.tree.map(np.asarray, host.center)
+        model_state = jax.tree.map(
+            lambda a: np.mean(np.asarray(a), axis=0), host.model_state)
+        return EngineState(
+            center=put_global(center, rep),
+            locals_=put_global(_stack_for_workers(
+                jax.tree.map(jnp.asarray, center), W), shard),
+            opt_state=put_global(_stack_for_workers(
+                self.tx.init(center), W), shard),
+            fold_state=put_global(host.fold_state, rep),
+            rng=put_global(host.rng, rep),
+            model_state=put_global(_stack_for_workers(
+                jax.tree.map(jnp.asarray, model_state), W), shard),
+        )
+
     def _put_batch(self, xs: np.ndarray, ys: np.ndarray):
         shard = NamedSharding(self.mesh, P(DATA_AXIS))
         return put_global(xs, shard), put_global(ys, shard)

@@ -157,7 +157,7 @@ class Trainer:
 
     def _execute(self, engine, plan):
         """Shared run harness: resume from checkpoint, per-round metrics/saves."""
-        state = engine.init_state()
+        state = None
         start = 0
         ckpt = logger = None
         if self.checkpoint_dir:
@@ -166,8 +166,36 @@ class Trainer:
             ckpt = Checkpointer(self.checkpoint_dir)
             if self.resume and ckpt.latest_step() is not None:
                 latest = ckpt.latest_step()
-                state = ckpt.restore(state, step=latest)
-                start = latest + 1
+                meta = ckpt.meta(latest) or {}
+                saved_w = meta.get("num_workers")
+                cur_w = getattr(engine, "num_workers", None)
+                if (saved_w is not None and cur_w is not None
+                        and saved_w != cur_w and hasattr(engine, "host_state")):
+                    # Elastic resume: the checkpoint was written at a
+                    # different worker count (pod resize). Restore on the
+                    # host at the saved topology, then re-join every worker
+                    # from the center (the reference's PS pull semantics).
+                    disc = getattr(engine, "discipline", None)
+                    if disc is not None and not disc.center_is_trained:
+                        raise ValueError(
+                            f"cannot elastically resume {type(disc).__name__}"
+                            " (worker count changed): its training progress"
+                            " lives in the per-worker replicas, not the"
+                            " center. Resume with the original num_workers="
+                            f"{saved_w}.")
+                    host = ckpt.restore_host(engine.host_state(saved_w),
+                                             step=latest)
+                    state = engine.adopt_state(host)
+                    # Round indices are topology-dependent (a round consumes
+                    # W*K*B samples): carry over DATA progress, not the raw
+                    # counter.
+                    start = min(((latest + 1) * saved_w) // cur_w,
+                                plan.num_rounds)
+                else:
+                    state = ckpt.restore(engine.init_state(), step=latest)
+                    start = latest + 1
+        if state is None:
+            state = engine.init_state()
         if self.metrics_path:
             from distkeras_tpu.metrics import MetricsLogger
 
@@ -196,7 +224,8 @@ class Trainer:
             if save_due[0] and st is not None:
                 # wait=True: the engine donates state buffers into the next
                 # round; the write must complete before training continues.
-                ckpt.save(r, st, wait=True)
+                ckpt.save(r, st, wait=True,
+                          meta={"num_workers": getattr(engine, "num_workers", 1)})
                 save_due[0] = False
 
         state, losses = engine.run(plan, state=state, start_round=start,

@@ -152,3 +152,69 @@ def test_checkpoint_resume_matches_uninterrupted(tmp_path):
     # and lands on the same weights as the uninterrupted run (deterministic folds)
     for a, b in zip(jax.tree.leaves(m_full.params), jax.tree.leaves(m_b.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_elastic_resume_different_worker_count(tmp_path):
+    """A checkpoint written at W=4 resumes at W=2 (pod resize): the center
+    variable carries over exactly — rejoining workers pull it, reference PS
+    semantics — and training continues to convergence."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.models import Model
+    from distkeras_tpu.models.mlp import MLP
+
+    rng = np.random.default_rng(0)
+    n, d, c = 640, 4, 3
+    centers = rng.normal(scale=4.0, size=(c, d))
+    y = rng.integers(0, c, size=n)
+    x = (centers[y] + rng.normal(scale=0.5, size=(n, d))).astype(np.float32)
+    df = dk.DataFrame({"features": x, "label": y.astype(np.int32)})
+
+    def model():
+        return Model.build(MLP(hidden=(16,), num_outputs=c),
+                           jnp.zeros((1, d), jnp.float32))
+
+    ck = str(tmp_path / "ck")
+    common = dict(loss="sparse_categorical_crossentropy", batch_size=16,
+                  learning_rate=0.1, communication_window=2,
+                  checkpoint_dir=ck, checkpoint_every=2)
+    t1 = dk.ADAG(model(), num_workers=4, num_epoch=2, **common)
+    first = t1.train(df)
+
+    # Resume at HALF the workers, doubling epochs: data progress (not the
+    # raw round counter) carries over, so the W=2 plan resumes exactly where
+    # the W=4 run's samples left off — round 20 of 40.
+    t2 = dk.ADAG(model(), num_workers=2, num_epoch=4, resume=True, **common)
+    resumed = t2.train(df)
+    logits = np.asarray(resumed.predict(jnp.asarray(x)))
+    acc = float((logits.argmax(-1) == y).mean())
+    assert acc > 0.9, f"elastic-resumed model failed to converge: {acc}"
+    assert len(t2.get_history()) == 20  # rounds 20..39, not a restart
+    # The resumed run continued, not restarted: its first-round loss is far
+    # below a cold start's (the W=4 model already fit the data).
+    assert t2.get_history()[0] < t1.get_history()[0] * 0.5
+
+
+def test_elastic_resume_rejects_ensemble(tmp_path):
+    """EnsembleFold trains only the per-worker replicas; pull-the-center
+    elastic resume would silently discard them — must refuse loudly."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.models import Model
+    from distkeras_tpu.models.mlp import MLP
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(256, 4)).astype(np.float32)
+    y = rng.integers(0, 3, size=256).astype(np.int32)
+    df = dk.DataFrame({"features": x, "label": y})
+
+    def model():
+        return Model.build(MLP(hidden=(8,), num_outputs=3),
+                           jnp.zeros((1, 4), jnp.float32))
+
+    ck = str(tmp_path / "ck")
+    common = dict(loss="sparse_categorical_crossentropy", batch_size=16,
+                  learning_rate=0.1, communication_window=2,
+                  checkpoint_dir=ck, checkpoint_every=2)
+    dk.EnsembleTrainer(model(), num_workers=4, num_epoch=2, **common).train(df)
+    with pytest.raises(ValueError, match="elastically"):
+        dk.EnsembleTrainer(model(), num_workers=2, num_epoch=2, resume=True,
+                           **common).train(df)
