@@ -73,6 +73,7 @@ class AsyncEngine:
         compute_dtype=None,
         seed: int = 0,
         per_worker_init: bool = False,
+        grad_accum: int = 1,
     ):
         self.model = model
         self.mesh = mesh
@@ -85,7 +86,7 @@ class AsyncEngine:
         self.loss_fn = get_loss(loss)
         self._local_loop = make_local_loop(
             model.module, self.loss_fn, self.tx, compute_dtype=compute_dtype,
-            state_collections=model.state_collections,
+            state_collections=model.state_collections, grad_accum=grad_accum,
         )
         self._multi_fns = {}
         self._round_fn = self._build_round_fn()

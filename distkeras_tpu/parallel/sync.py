@@ -48,6 +48,7 @@ class SyncEngine:
         learning_rate: float = 0.01,
         compute_dtype=None,
         seed: int = 0,
+        grad_accum: int = 1,
     ):
         self.model = model
         self.mesh = mesh
@@ -56,6 +57,7 @@ class SyncEngine:
         self.tx = get_optimizer(optimizer, learning_rate)
         self.loss_fn = get_loss(loss)
         self.compute_dtype = compute_dtype
+        self.grad_accum = int(grad_accum)
         self._multi_fns = {}
         self._round_fn = self._build_round_fn()
 
@@ -68,6 +70,7 @@ class SyncEngine:
             self.model.module, self.loss_fn, self.tx,
             compute_dtype=self.compute_dtype, grad_transform=sync_grads,
             state_collections=self.model.state_collections,
+            grad_accum=self.grad_accum,
         )
 
         def body(params, opt_state, rng, model_state, xs, ys):

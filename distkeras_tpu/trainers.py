@@ -95,6 +95,7 @@ class Trainer:
         resume: bool = False,
         rounds_per_program: int = 1,
         on_round=None,
+        grad_accum: int = 1,
         **kwargs,
     ):
         legacy = {k: kwargs.pop(k) for k in list(kwargs) if k in _LEGACY_SOCKET_KWARGS}
@@ -136,6 +137,12 @@ class Trainer:
         #: Keras-callback-shaped progress hook; reference workers printed
         #: per-batch logs on executors — here the driver sees every round).
         self.on_round = on_round
+        #: micro-batches per optimizer step (1/A the activation memory — for
+        #: batches that don't fit HBM; see workers.make_local_loop for the
+        #: BatchNorm/dropout semantics caveat).
+        self.grad_accum = int(grad_accum)
+        if self.grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
         self.history: np.ndarray | None = None
         self.worker_histories: np.ndarray | None = None
         self.training_time: float = 0.0
@@ -299,7 +306,7 @@ class SingleTrainer(Trainer):
         engine = SyncEngine(
             self.model, self.worker_optimizer, self.loss, mesh,
             learning_rate=self.learning_rate, compute_dtype=self.compute_dtype,
-            seed=self.seed,
+            seed=self.seed, grad_accum=self.grad_accum,
         )
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,
@@ -338,7 +345,7 @@ class SynchronousDistributedTrainer(DistributedTrainer):
         engine = SyncEngine(
             self.model, self.worker_optimizer, self.loss, mesh,
             learning_rate=self.learning_rate, compute_dtype=self.compute_dtype,
-            seed=self.seed,
+            seed=self.seed, grad_accum=self.grad_accum,
         )
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,
@@ -369,6 +376,7 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
             self.model, self.worker_optimizer, self.loss, self._discipline(), mesh,
             window=self.communication_window, learning_rate=self.learning_rate,
             compute_dtype=self.compute_dtype, seed=self.seed,
+            grad_accum=self.grad_accum,
         )
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,
@@ -472,6 +480,7 @@ class AveragingTrainer(DistributedTrainer):
             self.model, self.worker_optimizer, self.loss, EnsembleFold(), mesh,
             window=self.communication_window, learning_rate=self.learning_rate,
             compute_dtype=self.compute_dtype, seed=self.seed,
+            grad_accum=self.grad_accum,
         )
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,
@@ -502,6 +511,7 @@ class EnsembleTrainer(DistributedTrainer):
             self.model, self.worker_optimizer, self.loss, EnsembleFold(), mesh,
             window=self.communication_window, learning_rate=self.learning_rate,
             compute_dtype=self.compute_dtype, seed=self.seed, per_worker_init=True,
+            grad_accum=self.grad_accum,
         )
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,

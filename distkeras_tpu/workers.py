@@ -31,6 +31,7 @@ def make_local_loop(
     compute_dtype=None,
     grad_transform: Optional[Callable] = None,
     state_collections: Sequence[str] = (),
+    grad_accum: int = 1,
 ):
     """Build ``local_steps(params, opt_state, xs, ys, rng, state) ->
     (params, opt_state, state, losses)``.
@@ -55,9 +56,22 @@ def make_local_loop(
     deliberately NOT cast to ``compute_dtype`` — running statistics stay in
     their stored precision.
 
+    ``grad_accum=A`` splits every step's batch into A sequential micro-batches
+    and applies ONE optimizer update on their mean gradient at 1/A the
+    activation memory — the standard trick for batches that don't fit HBM.
+    For stateless, dropout-free models this is numerically the identical step
+    (the same mean gradient reaches ``tx.update``; equivalence-tested).
+    Caveats: BatchNorm statistics are computed per micro-batch (B/A samples,
+    momentum applied A times per step) and dropout masks take a per-micro rng
+    path — both standard accumulation semantics, but not bitwise equal to the
+    unaccumulated step. Mutable state threads through the micro-batches in
+    order.
+
     The rng handed in must be identical across replicas if determinism across
     restarts matters; per-step dropout keys are derived inside the scan.
     """
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
     cols = tuple(state_collections or ())
 
     def cast(x):
@@ -85,12 +99,36 @@ def make_local_loop(
         if rng is None:
             rng = jax.random.key(0)
 
+        def grad_of_step(p, st, x, y, sub):
+            if grad_accum == 1:
+                (loss, st), grads = jax.value_and_grad(loss_on_batch, has_aux=True)(
+                    p, st, x, y, sub)
+                return loss, st, grads
+            B = x.shape[0]
+            if B % grad_accum:
+                raise ValueError(
+                    f"batch size {B} not divisible by grad_accum={grad_accum}")
+            xm = x.reshape((grad_accum, B // grad_accum) + x.shape[1:])
+            ym = y.reshape((grad_accum, B // grad_accum) + y.shape[1:])
+
+            def micro(carry, i):
+                st_c, g_sum, l_sum = carry
+                (l, st_c), g = jax.value_and_grad(loss_on_batch, has_aux=True)(
+                    p, st_c, xm[i], ym[i], jax.random.fold_in(sub, i))
+                g_sum = jax.tree.map(jnp.add, g_sum, g)
+                return (st_c, g_sum, l_sum + l), None
+
+            g0 = jax.tree.map(jnp.zeros_like, p)
+            (st, g_sum, l_sum), _ = lax.scan(
+                micro, (st, g0, jnp.float32(0)), jnp.arange(grad_accum))
+            inv = 1.0 / grad_accum
+            return l_sum * inv, st, jax.tree.map(lambda g: g * inv, g_sum)
+
         def step(carry, batch):
             p, s, st, key = carry
             key, sub = jax.random.split(key)
             x, y = batch
-            (loss, st), grads = jax.value_and_grad(loss_on_batch, has_aux=True)(
-                p, st, x, y, sub)
+            loss, st, grads = grad_of_step(p, st, x, y, sub)
             if grad_transform is not None:
                 grads, loss = grad_transform(grads, loss)
             updates, s = tx.update(grads, s, p)

@@ -221,3 +221,31 @@ def test_rounds_per_program_checkpoint_resume(tmp_path):
         np.asarray(full.predict(jnp.asarray(df["features"][:32]))),
         np.asarray(resumed.predict(jnp.asarray(df["features"][:32]))),
         rtol=1e-5, atol=1e-6)
+
+
+def test_grad_accum_equivalence():
+    """grad_accum=A must produce the identical training trajectory to A=1
+    (same mean gradient per optimizer step), at 1/A activation memory."""
+    df = blob_df()
+    histories = []
+    for ga in (1, 4):
+        t = ADAG(tiny_model(), num_workers=4, communication_window=2,
+                 grad_accum=ga, **COMMON)
+        trained = t.train(df)
+        histories.append((t.get_history(),
+                          np.asarray(trained.predict(jnp.asarray(df["features"][:16])))))
+    np.testing.assert_allclose(histories[0][0], histories[1][0], rtol=1e-5)
+    np.testing.assert_allclose(histories[0][1], histories[1][1], rtol=1e-4, atol=1e-6)
+
+
+def test_grad_accum_sync_and_indivisible():
+    df = blob_df()
+    t = SynchronousDistributedTrainer(tiny_model(), num_workers=4, grad_accum=2,
+                                      **COMMON)
+    trained = t.train(df)
+    assert accuracy(trained, df) > 0.85
+    import pytest as _pytest
+    bad = SynchronousDistributedTrainer(tiny_model(), num_workers=4,
+                                        grad_accum=7, **COMMON)
+    with _pytest.raises(ValueError, match="divisible"):
+        bad.train(df)
