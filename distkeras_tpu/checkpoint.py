@@ -128,7 +128,17 @@ class Checkpointer:
             if not isinstance(a, jax.ShapeDtypeStruct) else
             jax.ShapeDtypeStruct(a.shape, a.dtype),
             _encode(target))
-        restored = self._mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+        import warnings
+
+        with warnings.catch_warnings():
+            # Orbax warns that restoring without shardings "is unsafe when
+            # restoring on a different topology" — that is precisely this
+            # method's job: the caller (adopt_state) re-topologizes the host
+            # arrays itself.
+            warnings.filterwarnings(
+                "ignore", message="Sharding info not provided when restoring")
+            restored = self._mngr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
         return jax.tree.map(
             lambda t, r: jax.random.wrap_key_data(r) if _is_key(t) else r,
             target, restored,
