@@ -60,13 +60,34 @@ class Checkpointer:
         )
 
     def save(self, step: int, state: Any, wait: bool = False,
-             meta: Optional[dict] = None) -> None:
+             meta: Optional[dict] = None) -> bool:
         """Async-save ``state`` (any pytree) at ``step``; ``wait`` blocks.
 
         ``meta`` (JSON-able; e.g. ``{"num_workers": W}``) lands next to the
         step so an elastic resume can discover the saved topology.
+
+        Returns whether the manager actually persisted the step. Orbax's
+        CheckpointManager silently declines any ``step <= latest_step()``;
+        callers must keep step numbering monotonic (``Trainer._execute``
+        offsets resumed step counters for exactly this reason). A declined
+        save warns and skips the meta write so a stale sidecar is never left
+        for a step that was not written.
         """
-        self._mngr.save(step, args=ocp.args.StandardSave(_encode(state)))
+        saved = bool(self._mngr.save(
+            step, args=ocp.args.StandardSave(_encode(state))))
+        if not saved:
+            import warnings
+
+            warnings.warn(
+                f"checkpoint save at step {step} was declined by the "
+                f"CheckpointManager (latest_step={self._mngr.latest_step()}); "
+                "state was NOT persisted. Step numbers must be strictly "
+                "increasing.",
+                stacklevel=2,
+            )
+            if wait:  # still a barrier for previously enqueued async saves
+                self._mngr.wait_until_finished()
+            return False
         if meta is not None and jax.process_index() == 0:
             import json
 
@@ -87,6 +108,7 @@ class Checkpointer:
                         pass
         if wait:
             self._mngr.wait_until_finished()
+        return True
 
     def meta(self, step: int) -> Optional[dict]:
         """The ``meta`` dict saved with ``step`` (None if absent)."""

@@ -166,18 +166,39 @@ class Trainer:
         """Shared run harness: resume from checkpoint, per-round metrics/saves."""
         state = None
         start = 0
+        # Orbax step = round + step_offset. Orbax declines saves at any
+        # step <= latest_step, and elastic resume can map the resume round
+        # BELOW the saved step (scale-up: start = (r+1)*saved_w//cur_w < r) —
+        # without an offset every post-resize checkpoint would be silently
+        # dropped until the counter passed the old step. The offset keeps the
+        # Orbax step sequence strictly increasing across any chain of resumes
+        # while ``meta["round"]`` records the true (topology-local) round.
+        step_offset = 0
         ckpt = logger = None
         if self.checkpoint_dir:
             from distkeras_tpu.checkpoint import Checkpointer
 
             ckpt = Checkpointer(self.checkpoint_dir)
-            if self.resume and ckpt.latest_step() is not None:
-                latest = ckpt.latest_step()
+            latest = ckpt.latest_step()
+            if self.resume and latest is not None:
                 meta = ckpt.meta(latest) or {}
+                true_round = int(meta.get("round", latest))
                 saved_w = meta.get("num_workers")
                 cur_w = getattr(engine, "num_workers", None)
-                if (saved_w is not None and cur_w is not None
-                        and saved_w != cur_w and hasattr(engine, "host_state")):
+                resized = (saved_w is not None and cur_w is not None
+                           and saved_w != cur_w)
+                if resized:
+                    # Round indices are topology-dependent: carry over DATA
+                    # progress (samples consumed), not the raw counter. Old
+                    # checkpoints without samples_per_round meta fall back to
+                    # the worker-count ratio (exact when batch/window are
+                    # unchanged, the common pod-resize case).
+                    saved_spr = meta.get("samples_per_round")
+                    num = saved_spr if saved_spr else saved_w
+                    den = plan.samples_per_round if saved_spr else cur_w
+                    start = min(((true_round + 1) * num) // den,
+                                plan.num_rounds)
+                if resized and hasattr(engine, "host_state"):
                     # Elastic resume: the checkpoint was written at a
                     # different worker count (pod resize). Restore on the
                     # host at the saved topology, then re-join every worker
@@ -193,14 +214,26 @@ class Trainer:
                     host = ckpt.restore_host(engine.host_state(saved_w),
                                              step=latest)
                     state = engine.adopt_state(host)
-                    # Round indices are topology-dependent (a round consumes
-                    # W*K*B samples): carry over DATA progress, not the raw
-                    # counter.
-                    start = min(((latest + 1) * saved_w) // cur_w,
-                                plan.num_rounds)
                 else:
                     state = ckpt.restore(engine.init_state(), step=latest)
-                    start = latest + 1
+                    if resized:
+                        # W-independent state (e.g. SyncEngine) restores
+                        # exactly under a resize; data progress still
+                        # rescales so the resumed run neither replays nor
+                        # skips a topology-dependent slice of the data.
+                        warnings.warn(
+                            f"resuming a checkpoint saved with num_workers="
+                            f"{saved_w} on num_workers={cur_w}: state "
+                            "restored exactly; data progress rescaled",
+                            stacklevel=2)
+                    else:
+                        start = true_round + 1
+                step_offset = (latest + 1) - start
+            elif latest is not None:
+                # Fresh run (resume=False) into a dir with prior checkpoints:
+                # rounds restart at 0, so without an offset every save would
+                # land at a step Orbax has already seen and be declined.
+                step_offset = latest + 1
         if state is None:
             state = engine.init_state()
         if self.metrics_path:
@@ -231,9 +264,14 @@ class Trainer:
             if save_due[0] and st is not None:
                 # wait=True: the engine donates state buffers into the next
                 # round; the write must complete before training continues.
-                ckpt.save(r, st, wait=True,
-                          meta={"num_workers": getattr(engine, "num_workers", 1)})
-                save_due[0] = False
+                # A declined save (e.g. another writer advanced the manager's
+                # latest_step) keeps the save due, to retry at the next
+                # state-bearing round instead of silently dropping it.
+                if ckpt.save(r + step_offset, st, wait=True,
+                             meta={"num_workers": getattr(engine, "num_workers", 1),
+                                   "round": r,
+                                   "samples_per_round": plan.samples_per_round}):
+                    save_due[0] = False
 
         state, losses = engine.run(plan, state=state, start_round=start,
                                    on_round=on_round,
