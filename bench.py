@@ -117,7 +117,7 @@ def _time_steps(step_once, warmup: int, timed: int, reps: int = None):
     return best
 
 
-def _bench_engine(engine, plan, warmup: int, timed: int, rounds_per_program: int = 1,
+def _bench_engine(engine, plan, warmup: int, timed: int, rounds_per_program=1,
                   reps: int = None):
     """Time `timed` fold rounds of an Async/Sync engine; returns elapsed seconds.
 
@@ -125,14 +125,39 @@ def _bench_engine(engine, plan, warmup: int, timed: int, rounds_per_program: int
     (``engine.multi_round_fn``) — semantics-preserving, and necessary here:
     host dispatch through the tunneled TPU costs ~4ms/call, which would
     otherwise bound every small-model config (mnist_mlp measured 6.7ms/round:
-    >60% dispatch).
+    >60% dispatch). ``"auto"`` probes the steady-state per-round time and
+    sizes R with the same constants as ``run_auto`` in parallel/engine.py.
     """
     import jax
     import numpy as _np
     from jax.sharding import NamedSharding, PartitionSpec as _P
 
-    R = max(1, min(rounds_per_program, timed))
     state = engine.init_state()
+    if rounds_per_program == "auto":
+        probe_shard = NamedSharding(engine.mesh, _P("data"))
+        xs0, ys0 = plan.round(0)
+        xs0 = jax.device_put(xs0, probe_shard)
+        ys0 = jax.device_put(ys0, probe_shard)
+        for _ in range(2):  # compile + tunnel warm-up
+            state, loss = engine._round_fn(state, xs0, ys0)
+            jax.device_get(loss)
+        # Steady-state probe: ANY single-round fence pays a fixed ~70-110 ms
+        # sync/fetch RTT through the tunneled device, so run a batch of
+        # unfenced rounds and fence once, then size R exactly the way the
+        # trainers do (same constants as run_auto in parallel/engine.py, so
+        # the bench measures the R a real run would pick).
+        from distkeras_tpu.parallel.engine import _auto_size_r, probe_steady
+
+        carry0 = {"s": state}
+
+        def _probe_one():
+            carry0["s"], loss = engine._round_fn(carry0["s"], xs0, ys0)
+            return loss
+
+        steady = probe_steady(_probe_one)
+        state = carry0["s"]
+        rounds_per_program = _auto_size_r(steady, xs0.nbytes + ys0.nbytes)
+    R = max(1, min(rounds_per_program, timed))
     # Pre-stage a few distinct blocks on device and cycle them: host input
     # transfer isn't what's being benchmarked (training overlaps it via the
     # RoundFeeder prefetcher), and staging dozens of unique rounds through the
@@ -388,18 +413,18 @@ def main():
         # 1 — correctness/throughput floor: MNIST MLP, single process
         ("mnist_mlp_single", mnist_mlp, "single",
          dict(batch_size=1024 if on_tpu else 64, window=8, sample_shape=(784,),
-              num_classes=10, timed=rounds(40), optimizer="adam",
-              rounds_per_program=8)),
+              num_classes=10, timed=rounds(64), optimizer="adam",
+              rounds_per_program="auto")),
         # 2 — MNIST CNN under ADAG (async adaptive gradients)
         ("mnist_cnn_adag", mnist_cnn, "adag",
          dict(batch_size=1024 if on_tpu else 32, window=8,
-              sample_shape=(28, 28, 1), num_classes=10, timed=rounds(24),
-              rounds_per_program=2)),
+              sample_shape=(28, 28, 1), num_classes=10, timed=rounds(32),
+              rounds_per_program="auto")),
         # 3 — NORTH STAR: CIFAR-10 CNN under AEASGD (elastic averaging)
         ("cifar10_cnn_aeasgd", cifar10_cnn, "aeasgd",
          dict(batch_size=1024 if on_tpu else 16, window=8,
               sample_shape=(32, 32, 3), num_classes=10, timed=rounds(16),
-              rounds_per_program=2)),
+              rounds_per_program="auto")),
         # 4 — IMDB LSTM under DynSGD (staleness-aware)
         # cell_impl="pallas": the whole recurrence as one Pallas program
         # (weights resident in VMEM across timesteps) — 1.9x over the XLA
@@ -410,7 +435,7 @@ def main():
          "dynsgd",
          dict(batch_size=512 if on_tpu else 8, window=4, sample_shape=(200,),
               num_classes=2, timed=rounds(24), int_inputs=True, vocab=20000,
-              rounds_per_program=2)),
+              rounds_per_program="auto")),
         # 5 — ResNet-50 sync DP (BASELINE's pod config, single-chip slice here)
         # CPU smoke swaps in the CIFAR-shaped tiny ResNet: compiling the full
         # 224x224 ResNet-50 fwd+bwd takes minutes on the 2-core box and the

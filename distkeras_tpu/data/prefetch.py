@@ -18,6 +18,14 @@ class RoundFeeder:
 
     ``stage(r) -> batch`` does the gather + device_put for round ``r``; it runs on
     the feeder thread. Exceptions propagate to the consumer on the next pop.
+
+    Abandonment-safe: if the consumer stops iterating early (``engine.run``
+    raised mid-loop, generator dropped), :meth:`close` runs from the
+    generator's ``finally`` — the feeder thread is unblocked from a full
+    queue, told to stop, and joined, and every staged batch still queued is
+    dropped. Without this the daemon thread would sit blocked on
+    ``Queue.put`` forever, pinning staged device arrays (HBM + host RAM) for
+    the life of the process.
     """
 
     def __init__(self, num_rounds: int, stage: Callable[[int], object],
@@ -27,23 +35,75 @@ class RoundFeeder:
         self.start_round = start_round
         self.depth = max(1, depth)
         self._q: queue.Queue = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _put(self, item) -> bool:
+        """Blocking put that aborts (returns False) once close() is called."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def _run(self):
         try:
             for r in range(self.start_round, self.num_rounds):
-                self._q.put((r, self.stage(r), None))
+                if self._stop.is_set():
+                    return
+                if not self._put((r, self.stage(r), None)):
+                    return
         except BaseException as e:  # noqa: BLE001 - propagate to consumer
-            self._q.put((-1, None, e))
+            self._put((-1, None, e))
         else:
-            self._q.put((None, None, None))  # sentinel
+            self._put((None, None, None))  # sentinel
+
+    def close(self, deadline_s: float = 10.0):
+        """Stop the feeder thread and drop all staged batches. Idempotent.
+
+        Bounded: a feeder wedged inside ``stage`` (e.g. a device_put to a
+        dead device) cannot be joined — after ``deadline_s`` the daemon
+        thread is abandoned so the consumer's original exception still
+        propagates instead of hanging the process in a ``finally``."""
+        import time
+
+        self._stop.set()
+        # Drain so a put blocked on a full queue wakes promptly; staged
+        # device-array references die here (including when the feeder thread
+        # already finished and left items + sentinel sitting in the queue).
+        t_end = time.monotonic() + deadline_s
+        while self._thread.is_alive() and time.monotonic() < t_end:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+        with self._q.mutex:
+            self._q.queue.clear()
 
     def __iter__(self) -> Iterator:
+        if self._stop.is_set():  # closed before iteration: nothing to yield
+            return
         self._thread.start()
-        while True:
-            r, batch, err = self._q.get()
-            if err is not None:
-                raise err
-            if r is None:
-                return
-            yield r, batch
+        try:
+            while True:
+                try:
+                    # Timed get: a concurrent close() suppresses the
+                    # sentinel (the stopped feeder never enqueues it), so an
+                    # untimed get would block forever.
+                    r, batch, err = self._q.get(timeout=0.1)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        return
+                    continue
+                if err is not None:
+                    raise err
+                if r is None:
+                    return
+                yield r, batch
+        finally:
+            # Runs on normal exhaustion AND on abandonment (consumer raised /
+            # dropped the generator -> GeneratorExit lands at the yield).
+            self.close()

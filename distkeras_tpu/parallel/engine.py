@@ -266,7 +266,7 @@ class AsyncEngine:
         state: Optional[EngineState] = None,
         start_round: int = 0,
         on_round: Optional[Callable] = None,
-        rounds_per_program: int = 1,
+        rounds_per_program: "int | str" = 1,
     ):
         """Execute fold rounds ``start_round..num_rounds`` (resume-aware).
 
@@ -283,22 +283,162 @@ class AsyncEngine:
             )
         if state is None:
             state = self.init_state()
-        if rounds_per_program <= 1:
-            losses = []
-            from distkeras_tpu.data.prefetch import RoundFeeder
+        return run_rounds(self, plan, state, start_round, on_round,
+                          rounds_per_program)
 
-            feeder = RoundFeeder(plan.num_rounds,
-                                 lambda r: self._put_batch(*plan.round(r)),
-                                 start_round=start_round)
-            for r, (xs, ys) in feeder:
-                new_state, loss = self._round_fn(state, xs, ys)
-                losses.append(loss)
-                if on_round is not None:
-                    on_round(r, loss, new_state)
-                state = new_state
-            return state, np.asarray([np.asarray(l) for l in losses])
-        return run_blocked(self, plan, state, start_round, on_round,
-                           rounds_per_program)
+
+def run_rounds(engine, plan, state, start_round, on_round, rounds_per_program):
+    """Dispatch to the per-round / blocked / auto-sized run loop (shared by the
+    sync and async engines). ``rounds_per_program`` may be an int (fixed R) or
+    ``"auto"`` — probe the per-round wall time and pick R to fill
+    ``_AUTO_TARGET_S`` (~64 ms) of device work per dispatched program
+    (semantics-preserving either way; see multi_round_fn)."""
+    if rounds_per_program == "auto":
+        return run_auto(engine, plan, state, start_round, on_round)
+    if int(rounds_per_program) > 1:
+        return run_blocked(engine, plan, state, start_round, on_round,
+                           int(rounds_per_program))
+    return run_per_round(engine, plan, state, start_round, on_round)
+
+
+def run_per_round(engine, plan, state, start_round, on_round):
+    """One XLA dispatch per fold round, with background batch staging."""
+    from distkeras_tpu.data.prefetch import RoundFeeder
+
+    losses = []
+    feeder = RoundFeeder(plan.num_rounds,
+                         lambda r: engine._put_batch(*plan.round(r)),
+                         start_round=start_round)
+    for r, (xs, ys) in feeder:
+        new_state, loss = engine._round_fn(state, xs, ys)
+        # Keep the device value: fetching here would fence every dispatch
+        # (~100 ms RTT through a tunneled device); convert once at the end.
+        losses.append(loss)
+        if on_round is not None:
+            on_round(r, loss, new_state)
+        state = new_state
+    # One batched fetch — per-item np.asarray would pay one D2H round-trip
+    # (~70-110 ms through a tunneled device) per round.
+    return state, np.asarray(jax.device_get(losses))
+
+
+#: auto-R sizing. The probe must measure the STEADY-STATE per-round cost:
+#: dispatch is async, and ANY single-round fence pays a fixed ~70-110 ms
+#: sync/fetch round-trip through the tunneled device — so the probe runs a
+#: batch of unfenced rounds and fences once (block_until_ready amortizes:
+#: MNIST-MLP measured 4.1 ms/round steady vs 77 ms single-fenced). R then
+#: targets ~64 ms of device work per program — past the dispatch-amortization
+#: knee for tiny models (4.8 ms/round at R=1 -> 2.0 ms at R=16) without the
+#: oversize penalty (a 16-round scanned LSTM program measured 16% slower per
+#: round than a 4-round one). Block batches live in HBM — the byte cap
+#: bounds the staged [R, W, K, B, ...] arrays.
+_AUTO_MAX_R = 64
+_AUTO_BLOCK_BYTES = 256e6
+_AUTO_PROBE_ROUNDS = 15
+_AUTO_TARGET_S = 0.064
+
+
+def _auto_size_r(steady_s: float, round_bytes: int) -> int:
+    """Rounds per program from a measured steady-state per-round time —
+    the single sizing rule shared by run_auto and bench.py's probe."""
+    return max(1, min(_AUTO_MAX_R,
+                      max(1, int(_AUTO_BLOCK_BYTES / max(round_bytes, 1))),
+                      int(np.ceil(_AUTO_TARGET_S / max(steady_s, 1e-6)))))
+
+
+def probe_steady(dispatch_round, n: int = _AUTO_PROBE_ROUNDS) -> float:
+    """Steady-state per-round seconds: ``n`` unfenced dispatches, ONE fence
+    (any per-round fence pays the full ~70-110 ms tunnel sync RTT). The
+    shared measurement protocol for pre-staged probes (bench.py); run_auto
+    inlines the same loop because it also collects losses and excludes
+    staging time."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    fence = None
+    for _ in range(n):
+        fence = dispatch_round()
+    jax.block_until_ready(fence)
+    return max((_time.perf_counter() - t0) / n, 1e-6)
+
+
+def run_auto(engine, plan, state, start_round, on_round):
+    """``rounds_per_program="auto"``: probe the steady-state per-round wall
+    time on the first few (real) rounds, then execute the rest in blocks of
+    ``R ≈ target/round_time`` rounds per dispatch. Loss history and final
+    state are identical to any fixed-R run."""
+    import time as _time
+
+    if start_round >= plan.num_rounds:  # resumed past the end: nothing to do
+        return state, np.asarray([])
+    losses = []
+    r = start_round
+    round_bytes = 1
+
+    # Round 1 fences compile (its callback runs inline — we're not timing yet).
+    xs, ys = engine._put_batch(*plan.round(r))
+    state, loss = engine._round_fn(state, xs, ys)
+    losses.append(loss)
+    if on_round is not None:
+        on_round(r, loss, state)
+    r += 1
+    jax.block_until_ready(loss)
+
+    # Timed probe: unfenced rounds, one fence at the end. Callbacks are
+    # DEFERRED out of the window entirely — a callback that fetches the loss
+    # (MetricsLogger) or blocks on a checkpoint write would fence device
+    # compute inside any "excluded" sub-window and corrupt the measurement
+    # in either direction. Staging time is NOT subtracted: dispatch is async,
+    # so host-side staging of round i+1 overlaps the device crunching round
+    # i, and the wall clock already reads ~n*max(compute, staging) — which is
+    # exactly the steady per-round cost the blocked phase (with RoundFeeder
+    # lookahead) will see.
+    pending = []
+    n = 0
+    t0 = _time.perf_counter()
+    while r < plan.num_rounds and n < _AUTO_PROBE_ROUNDS:
+        xs, ys = engine._put_batch(*plan.round(r))
+        round_bytes = sum(int(a.nbytes) for a in jax.tree.leaves((xs, ys)))
+        state, loss = engine._round_fn(state, xs, ys)
+        losses.append(loss)
+        pending.append((r, loss))
+        r += 1
+        n += 1
+    head_done = r >= plan.num_rounds
+    if n:
+        jax.block_until_ready(loss)
+        steady = max((_time.perf_counter() - t0) / n, 1e-6)
+    host_all = None
+    if on_round is not None and pending:
+        # One batched fetch of ALL head losses (round 1 + probe rounds), then
+        # callbacks see host arrays — per-callback np.asarray(loss)
+        # (MetricsLogger) would otherwise issue up to 16 sequential D2H
+        # round-trips before the blocked phase dispatches. The same host
+        # copies serve as the returned head, so nothing is fetched twice.
+        host_all = jax.device_get(losses)
+        # Same contract as run_blocked: only the final call of the probe
+        # "block" carries a state (interior states were donated onward).
+        for i, (rr, _) in enumerate(pending):
+            on_round(rr, host_all[1 + i],
+                     state if i == len(pending) - 1 else None)
+    if head_done:
+        return state, np.asarray(
+            host_all if host_all is not None else jax.device_get(losses))
+    R = min(_auto_size_r(steady, round_bytes), plan.num_rounds - r)
+    if jax.process_count() > 1:
+        # Every process must run identical blocked programs (mismatched R
+        # means mismatched collectives -> distributed hang). Wall-clock
+        # differs per host; take process 0's sizing everywhere.
+        from jax.experimental import multihost_utils
+
+        R = int(multihost_utils.broadcast_one_to_all(np.int32(R)))
+    state, rest = run_blocked(engine, plan, state, r, on_round, R)
+    # Without callbacks the head losses were never needed earlier — fetch
+    # them only now, after the blocked phase dispatched, so the device never
+    # idled on a D2H fetch between probe and blocked work.
+    head = np.asarray(
+        host_all if host_all is not None else jax.device_get(losses))
+    return state, np.concatenate([head, np.asarray(rest)], axis=0)
 
 
 def run_blocked(engine, plan, state, start_round, on_round, R):

@@ -135,31 +135,18 @@ class SyncEngine:
         state: Optional[SyncState] = None,
         start_round: int = 0,
         on_round: Optional[Callable] = None,
-        rounds_per_program: int = 1,
+        rounds_per_program: "int | str" = 1,
     ):
         """Execute rounds ``start_round..num_rounds``; ``on_round(r, loss, state)``
-        (see AsyncEngine.run for the donation caveat)."""
+        (see AsyncEngine.run for the donation caveat).
+        ``rounds_per_program``: int or ``"auto"`` (engine.run_rounds)."""
         if plan.num_workers != self.num_workers:
             raise ValueError(
                 f"plan built for {plan.num_workers} workers, mesh has {self.num_workers}"
             )
         if state is None:
             state = self.init_state()
-        if rounds_per_program > 1:
-            from distkeras_tpu.parallel.engine import run_blocked
+        from distkeras_tpu.parallel.engine import run_rounds
 
-            return run_blocked(self, plan, state, start_round, on_round,
-                               rounds_per_program)
-        losses = []
-        from distkeras_tpu.data.prefetch import RoundFeeder
-
-        feeder = RoundFeeder(plan.num_rounds,
-                             lambda r: self._put_batch(*plan.round(r)),
-                             start_round=start_round)
-        for r, (xs, ys) in feeder:
-            new_state, loss = self._round_fn(state, xs, ys)
-            losses.append(loss)
-            if on_round is not None:
-                on_round(r, loss, new_state)
-            state = new_state
-        return state, np.asarray([float(l) for l in losses])
+        return run_rounds(self, plan, state, start_round, on_round,
+                          rounds_per_program)

@@ -93,7 +93,7 @@ class Trainer:
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 0,
         resume: bool = False,
-        rounds_per_program: int = 1,
+        rounds_per_program: Union[int, str] = 1,
         on_round=None,
         grad_accum: int = 1,
         **kwargs,
@@ -132,7 +132,18 @@ class Trainer:
         #: Semantics-preserving dispatch amortization: raise it when host
         #: dispatch latency, not the device, bounds small-model throughput.
         #: Checkpoints then land on block boundaries (exact-resume-safe).
-        self.rounds_per_program = int(rounds_per_program)
+        #: ``"auto"`` probes the per-round wall time and sizes R to fill
+        #: ~64 ms of device work per program (engine._AUTO_TARGET_S) — the
+        #: right default for small models on dispatch-latency-heavy paths
+        #: (no hand tuning).
+        if rounds_per_program == "auto":
+            self.rounds_per_program: Union[int, str] = "auto"
+        else:
+            self.rounds_per_program = int(rounds_per_program)
+            if self.rounds_per_program < 1:
+                raise ValueError(
+                    f"rounds_per_program must be >= 1 or 'auto', got "
+                    f"{rounds_per_program}")
         #: optional ``f(round, loss)`` fired after every fold round (the
         #: Keras-callback-shaped progress hook; reference workers printed
         #: per-batch logs on executors — here the driver sees every round).
@@ -182,6 +193,15 @@ class Trainer:
             latest = ckpt.latest_step()
             if self.resume and latest is not None:
                 meta = ckpt.meta(latest) or {}
+                if not meta:
+                    # Orbax steps are offset from rounds across resumes; with
+                    # the sidecar gone the raw step is only an upper bound on
+                    # the true round. Resume conservatively from it, loudly.
+                    warnings.warn(
+                        f"checkpoint step {latest} has no meta sidecar; "
+                        "treating the step as the round index — if this run "
+                        "chain was ever resumed or resized, data progress "
+                        "may be overestimated", stacklevel=2)
                 true_round = int(meta.get("round", latest))
                 saved_w = meta.get("num_workers")
                 cur_w = getattr(engine, "num_workers", None)
@@ -227,7 +247,7 @@ class Trainer:
                             "restored exactly; data progress rescaled",
                             stacklevel=2)
                     else:
-                        start = true_round + 1
+                        start = min(true_round + 1, plan.num_rounds)
                 step_offset = (latest + 1) - start
             elif latest is not None:
                 # Fresh run (resume=False) into a dir with prior checkpoints:
@@ -248,6 +268,11 @@ class Trainer:
 
         save_due = [False]  # a scheduled save passed while no state was out
 
+        def _meta(r):
+            return {"num_workers": getattr(engine, "num_workers", 1),
+                    "round": r,
+                    "samples_per_round": plan.samples_per_round}
+
         def on_round(r, loss, st):
             if logger is not None:
                 logger(r, loss)
@@ -267,16 +292,38 @@ class Trainer:
                 # A declined save (e.g. another writer advanced the manager's
                 # latest_step) keeps the save due, to retry at the next
                 # state-bearing round instead of silently dropping it.
-                if ckpt.save(r + step_offset, st, wait=True,
-                             meta={"num_workers": getattr(engine, "num_workers", 1),
-                                   "round": r,
-                                   "samples_per_round": plan.samples_per_round}):
+                if ckpt.save(r + step_offset, st, wait=True, meta=_meta(r)):
                     save_due[0] = False
 
-        state, losses = engine.run(plan, state=state, start_round=start,
-                                   on_round=on_round,
-                                   rounds_per_program=self.rounds_per_program)
+        try:
+            state, losses = engine.run(
+                plan, state=state, start_round=start, on_round=on_round,
+                rounds_per_program=self.rounds_per_program)
+        except BaseException:
+            # Close on failure too: orbax's background threads and the
+            # metrics file handle must not leak across in-process retries.
+            # Suppress close errors (an in-flight async save can raise from
+            # wait_until_finished) so the root-cause failure propagates.
+            import contextlib
+
+            if ckpt is not None:
+                with contextlib.suppress(Exception):
+                    ckpt.close()
+            if logger is not None:
+                with contextlib.suppress(Exception):
+                    logger.close()
+            raise
         if ckpt is not None:
+            if save_due[0] and plan.num_rounds > start:
+                # The final scheduled save was declined (e.g. another writer
+                # advanced the manager's latest_step past our sequence) and
+                # there was no later round to retry at — persist the terminal
+                # state at the next step the manager will accept.
+                final_r = plan.num_rounds - 1
+                latest_now = ckpt.latest_step()
+                step = max(final_r + step_offset,
+                           (-1 if latest_now is None else latest_now) + 1)
+                ckpt.save(step, state, wait=True, meta=_meta(final_r))
             ckpt.close()
         if logger is not None:
             logger.close()

@@ -124,3 +124,46 @@ def test_round_feeder_propagates_errors():
     feeder = RoundFeeder(5, stage)
     with pytest.raises(RuntimeError, match="boom"):
         list(feeder)
+
+
+def test_round_feeder_abandonment_stops_thread():
+    """A consumer that dies mid-loop (OOM, tunnel flake) must not leave the
+    feeder thread blocked on Queue.put holding staged batches forever."""
+    import time
+    import weakref
+
+    class Batch:  # stand-in for a staged device array
+        pass
+
+    alive = []
+
+    def stage(r):
+        b = Batch()
+        alive.append(weakref.ref(b))
+        return b
+
+    feeder = RoundFeeder(1000, stage, depth=2)
+
+    def consume_then_die():
+        for r, batch in feeder:
+            if r == 3:
+                raise RuntimeError("simulated mid-training failure")
+
+    with pytest.raises(RuntimeError, match="mid-training"):
+        consume_then_die()
+    deadline = time.time() + 5
+    while feeder._thread.is_alive() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not feeder._thread.is_alive(), "feeder thread leaked"
+    # every staged batch the consumer never took has been dropped
+    import gc
+
+    gc.collect()
+    assert all(ref() is None for ref in alive)
+
+
+def test_round_feeder_close_idempotent_before_and_after_use():
+    feeder = RoundFeeder(3, lambda r: r)
+    assert list(feeder) == [(0, 0), (1, 1), (2, 2)]
+    feeder.close()
+    feeder.close()

@@ -176,6 +176,56 @@ def test_sync_rounds_per_program_equivalence():
     np.testing.assert_allclose(histories[0], histories[1], rtol=1e-6)
 
 
+def test_rounds_per_program_auto_equivalence():
+    """rounds_per_program='auto' (probe + self-sized blocks) must reproduce
+    the fixed-R trajectory exactly — it only re-partitions dispatches."""
+    df = blob_df()
+    kw = {**COMMON, "num_epoch": 6}  # 640/(4*2*16)=5 rounds/epoch -> 30 > 16
+    results = []
+    for rpp in (1, "auto"):
+        t = ADAG(tiny_model(), num_workers=4, communication_window=2,
+                 rounds_per_program=rpp, **kw)
+        trained = t.train(df)
+        # past the 16-round probe head: blocked continuation + concat covered
+        assert len(t.get_history()) == 30
+        results.append((t.get_history(), np.asarray(trained.predict(
+            jnp.asarray(df["features"][:16])))))
+    np.testing.assert_allclose(results[0][0], results[1][0], rtol=1e-6)
+    np.testing.assert_allclose(results[0][1], results[1][1], rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError, match="rounds_per_program"):
+        ADAG(tiny_model(), rounds_per_program=0, **COMMON)
+
+
+def test_rounds_per_program_auto_resume_past_end(tmp_path):
+    """Resuming a completed run with rounds_per_program='auto' must return an
+    empty history, not crash probing a round past the plan's end."""
+    df = blob_df(n=256)
+    ck = str(tmp_path / "ck")
+    kw = dict(num_workers=4, communication_window=2, rounds_per_program="auto",
+              checkpoint_dir=ck, checkpoint_every=1,
+              metrics_path=str(tmp_path / "m.jsonl"), **COMMON)
+    dk_t = ADAG(tiny_model(), **kw)
+    dk_t.train(df)
+    t2 = ADAG(tiny_model(), resume=True, **kw)
+    t2.train(df)
+    assert len(t2.get_history()) == 0
+
+
+def test_rounds_per_program_auto_sync():
+    df = blob_df()
+    kw = {**COMMON, "num_epoch": 6}
+    histories = []
+    for rpp in (1, "auto"):
+        t = SynchronousDistributedTrainer(tiny_model(), num_workers=4,
+                                          steps_per_program=2,
+                                          rounds_per_program=rpp, **kw)
+        t.train(df)
+        # 640/(4*2*16)=5 rounds/epoch x 6 = 30 > 16-round probe head
+        assert len(t.get_history()) == 30
+        histories.append(t.get_history())
+    np.testing.assert_allclose(histories[0], histories[1], rtol=1e-6)
+
+
 def test_bfloat16_compute_converges():
     """Mixed precision (bf16 fwd/bwd, fp32 master params) still converges."""
     df = blob_df()
