@@ -127,6 +127,9 @@ def _bench_engine(engine, plan, warmup: int, timed: int, rounds_per_program=1,
     otherwise bound every small-model config (mnist_mlp measured 6.7ms/round:
     >60% dispatch). ``"auto"`` probes the steady-state per-round time and
     sizes R with the same constants as ``run_auto`` in parallel/engine.py.
+    (The bench probe re-dispatches one resident batch, so it measures compute
+    only; a real run's probe includes staging and can size R smaller for
+    input-bound configs — bench numbers are an upper bound on that path.)
     """
     import jax
     import numpy as _np
@@ -134,10 +137,9 @@ def _bench_engine(engine, plan, warmup: int, timed: int, rounds_per_program=1,
 
     state = engine.init_state()
     if rounds_per_program == "auto":
-        probe_shard = NamedSharding(engine.mesh, _P("data"))
-        xs0, ys0 = plan.round(0)
-        xs0 = jax.device_put(xs0, probe_shard)
-        ys0 = jax.device_put(ys0, probe_shard)
+        # Stage through the engine's own path (put_global handles
+        # multi-process shardings; a raw device_put would not).
+        xs0, ys0 = engine._put_batch(*plan.round(0))
         for _ in range(2):  # compile + tunnel warm-up
             state, loss = engine._round_fn(state, xs0, ys0)
             jax.device_get(loss)
@@ -156,6 +158,7 @@ def _bench_engine(engine, plan, warmup: int, timed: int, rounds_per_program=1,
 
         steady = probe_steady(_probe_one)
         state = carry0["s"]
+        # _auto_size_r also handles the multi-process R agreement.
         rounds_per_program = _auto_size_r(steady, xs0.nbytes + ys0.nbytes)
     R = max(1, min(rounds_per_program, timed))
     # Pre-stage a few distinct blocks on device and cycle them: host input
@@ -166,10 +169,13 @@ def _bench_engine(engine, plan, warmup: int, timed: int, rounds_per_program=1,
     n_blocks = max(1, min(plan.num_rounds // R, 2))
 
     def stage(i):
+        from distkeras_tpu.runtime.mesh import put_global
+
         rs = range(i * R, i * R + R)
         xs = _np.stack([plan.round(r % plan.num_rounds)[0] for r in rs])
         ys = _np.stack([plan.round(r % plan.num_rounds)[1] for r in rs])
-        return jax.device_put(xs, shard), jax.device_put(ys, shard)
+        # put_global: multi-process shardings need the callback path.
+        return put_global(xs, shard), put_global(ys, shard)
 
     staged = [stage(i) for i in range(n_blocks)]
     fn = engine.multi_round_fn(R) if R > 1 else None

@@ -84,8 +84,11 @@ class RoundFeeder:
             self._q.queue.clear()
 
     def __iter__(self) -> Iterator:
-        if self._stop.is_set():  # closed before iteration: nothing to yield
-            return
+        if self._stop.is_set():
+            # Closed (or already fully consumed — normal exhaustion closes
+            # too): fail loudly rather than silently yielding zero rounds.
+            raise RuntimeError(
+                "RoundFeeder is closed; construct a new feeder per run")
         self._thread.start()
         try:
             while True:

@@ -42,6 +42,15 @@ def _global_positions(local_len: int, seq_axis: Optional[str]) -> jax.Array:
     return pos
 
 
+def _flash_supported_len(L: int) -> bool:
+    """Whether the flash kernel can handle sequence length ``L`` here: on
+    TPU the Mosaic kernel needs lane-aligned blocks (L a multiple of 128);
+    the CPU interpreter also accepts any single short block."""
+    if L % 128 == 0:
+        return True
+    return jax.default_backend() != "tpu" and L < 128
+
+
 class CausalSelfAttention(nn.Module):
     num_heads: int
     d_model: int
@@ -62,7 +71,12 @@ class CausalSelfAttention(nn.Module):
             from distkeras_tpu.ops.ring_attention import ring_attention
 
             out = ring_attention(q, k, v, axis_name=self.seq_axis)
-        elif self.seq_axis is None and self.attn_impl == "flash":
+        elif (self.seq_axis is None and self.attn_impl == "flash"
+              and _flash_supported_len(L)):
+            # On TPU, L must be lane-aligned (a multiple of 128) for the
+            # Mosaic kernel; shorter/odd lengths — e.g. the (1, 1) dummy
+            # used for shape inference at Model.build — take the dense path
+            # below, which is numerically identical.
             from distkeras_tpu.ops.pallas import flash_attention
 
             def fa(q, k, v):

@@ -309,14 +309,20 @@ def run_per_round(engine, plan, state, start_round, on_round):
     feeder = RoundFeeder(plan.num_rounds,
                          lambda r: engine._put_batch(*plan.round(r)),
                          start_round=start_round)
-    for r, (xs, ys) in feeder:
-        new_state, loss = engine._round_fn(state, xs, ys)
-        # Keep the device value: fetching here would fence every dispatch
-        # (~100 ms RTT through a tunneled device); convert once at the end.
-        losses.append(loss)
-        if on_round is not None:
-            on_round(r, loss, new_state)
-        state = new_state
+    try:
+        for r, (xs, ys) in feeder:
+            new_state, loss = engine._round_fn(state, xs, ys)
+            # Keep the device value: fetching here would fence every dispatch
+            # (~100 ms RTT through a tunneled device); convert once at the end.
+            losses.append(loss)
+            if on_round is not None:
+                on_round(r, loss, new_state)
+            state = new_state
+    finally:
+        # Deterministic shutdown even when the escaping exception (and its
+        # traceback's frames) is retained by the caller — generator GC alone
+        # would leave the feeder staging batches indefinitely.
+        feeder.close()
     # One batched fetch — per-item np.asarray would pay one D2H round-trip
     # (~70-110 ms through a tunneled device) per round.
     return state, np.asarray(jax.device_get(losses))
@@ -340,10 +346,21 @@ _AUTO_TARGET_S = 0.064
 
 def _auto_size_r(steady_s: float, round_bytes: int) -> int:
     """Rounds per program from a measured steady-state per-round time —
-    the single sizing rule shared by run_auto and bench.py's probe."""
-    return max(1, min(_AUTO_MAX_R,
-                      max(1, int(_AUTO_BLOCK_BYTES / max(round_bytes, 1))),
-                      int(np.ceil(_AUTO_TARGET_S / max(steady_s, 1e-6)))))
+    the single sizing rule shared by run_auto and bench.py's probe.
+
+    Multi-process: every process must run identical blocked programs
+    (mismatched R means mismatched collectives -> distributed hang), but
+    wall clocks differ per host — process 0's sizing is broadcast to all.
+    Callers may further clamp by process-deterministic values (e.g. rounds
+    remaining) without breaking agreement."""
+    R = max(1, min(_AUTO_MAX_R,
+                   max(1, int(_AUTO_BLOCK_BYTES / max(round_bytes, 1))),
+                   int(np.ceil(_AUTO_TARGET_S / max(steady_s, 1e-6)))))
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        R = int(multihost_utils.broadcast_one_to_all(np.int32(R)))
+    return R
 
 
 def probe_steady(dispatch_round, n: int = _AUTO_PROBE_ROUNDS) -> float:
@@ -424,14 +441,9 @@ def run_auto(engine, plan, state, start_round, on_round):
     if head_done:
         return state, np.asarray(
             host_all if host_all is not None else jax.device_get(losses))
+    # num_rounds - r is process-deterministic, so the clamp preserves the
+    # cross-process agreement _auto_size_r establishes.
     R = min(_auto_size_r(steady, round_bytes), plan.num_rounds - r)
-    if jax.process_count() > 1:
-        # Every process must run identical blocked programs (mismatched R
-        # means mismatched collectives -> distributed hang). Wall-clock
-        # differs per host; take process 0's sizing everywhere.
-        from jax.experimental import multihost_utils
-
-        R = int(multihost_utils.broadcast_one_to_all(np.int32(R)))
     state, rest = run_blocked(engine, plan, state, r, on_round, R)
     # Without callbacks the head losses were never needed earlier — fetch
     # them only now, after the blocked phase dispatched, so the device never
@@ -463,20 +475,31 @@ def run_blocked(engine, plan, state, start_round, on_round, R):
 
     losses = []
     feeder = RoundFeeder(len(starts), stage)
-    for i, (xs, ys) in feeder:
-        n = xs.shape[0]
-        new_state, block_losses = engine.multi_round_fn(n)(state, xs, ys)
-        host_losses = np.asarray(block_losses)
-        if on_round is not None:
-            for j in range(n):
-                # Only the block-final call carries state: interior rounds'
-                # states never exist on the host, and handing out the
-                # block-final state under an interior round label would let a
-                # checkpoint resume re-apply rounds it already contains.
-                st = new_state if j == n - 1 else None
-                on_round(starts[i] + j, host_losses[j], st)
-        losses.extend(host_losses)
-        state = new_state
+    try:
+        for i, (xs, ys) in feeder:
+            n = xs.shape[0]
+            new_state, block_losses = engine.multi_round_fn(n)(state, xs, ys)
+            if on_round is not None:
+                host_losses = np.asarray(block_losses)
+                for j in range(n):
+                    # Only the block-final call carries state: interior
+                    # rounds' states never exist on the host, and handing out
+                    # the block-final state under an interior round label
+                    # would let a checkpoint resume re-apply rounds it
+                    # already contains.
+                    st = new_state if j == n - 1 else None
+                    on_round(starts[i] + j, host_losses[j], st)
+                losses.extend(host_losses)
+            else:
+                # No callbacks -> keep losses on device; one per-block D2H
+                # fence would idle the device for the ~70-110 ms tunnel RTT
+                # every block. One batched fetch at the end instead.
+                losses.append(block_losses)
+            state = new_state
+    finally:
+        feeder.close()  # deterministic even if the exception is retained
+    if losses and on_round is None:  # device blocks: one batched fetch
+        losses = list(np.concatenate(jax.device_get(losses), axis=0))
     return state, np.asarray(losses)
 
 

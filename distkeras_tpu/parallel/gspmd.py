@@ -43,6 +43,31 @@ class GSPMDEngine:
         aux_loss_weight: float = 0.0,
         compute_dtype=None,
     ):
+        # Construction-time guards for model configs that need BOUND mesh
+        # axes. Under plain jit the abstract mesh is empty (verified on this
+        # JAX version), so the flash path's nested-shard_map manualization
+        # never engages — a Mosaic custom call is not GSPMD-auto-
+        # partitionable and the failure would otherwise surface as an opaque
+        # TPU trace/compile error deep inside XLA. (CPU interpret mode
+        # lowers Pallas to plain HLO and masks the problem entirely.)
+        impl = getattr(model.module, "attn_impl", None)
+        if impl == "flash":
+            raise ValueError(
+                "GSPMDEngine cannot host attn_impl='flash': the Mosaic "
+                "flash-attention kernel is not GSPMD-auto-partitionable and "
+                "plain jit binds no mesh axes for the kernel's manual "
+                "region. Use SPMDEngine (shard_map-based — it hosts the "
+                "flash kernel via a nested manual region), or "
+                "attn_impl='dense' with GSPMDEngine."
+            )
+        if getattr(model.module, "seq_axis", None) is not None:
+            raise ValueError(
+                "GSPMDEngine cannot host seq_axis="
+                f"{model.module.seq_axis!r}: ring/gather sequence "
+                "parallelism uses named-axis collectives (ppermute/"
+                "all_gather), which need a shard_map-bound axis. Use "
+                "SPMDEngine for sequence parallelism."
+            )
         self.model = model
         self.mesh = mesh
         self.rules = rules

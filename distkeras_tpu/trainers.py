@@ -138,12 +138,13 @@ class Trainer:
         #: (no hand tuning).
         if rounds_per_program == "auto":
             self.rounds_per_program: Union[int, str] = "auto"
+        elif (isinstance(rounds_per_program, str)
+              or int(rounds_per_program) < 1):
+            raise ValueError(
+                f"rounds_per_program must be an int >= 1 or 'auto', got "
+                f"{rounds_per_program!r}")
         else:
             self.rounds_per_program = int(rounds_per_program)
-            if self.rounds_per_program < 1:
-                raise ValueError(
-                    f"rounds_per_program must be >= 1 or 'auto', got "
-                    f"{rounds_per_program}")
         #: optional ``f(round, loss)`` fired after every fold round (the
         #: Keras-callback-shaped progress hook; reference workers printed
         #: per-batch logs on executors — here the driver sees every round).
@@ -299,6 +300,16 @@ class Trainer:
             state, losses = engine.run(
                 plan, state=state, start_round=start, on_round=on_round,
                 rounds_per_program=self.rounds_per_program)
+            if ckpt is not None and save_due[0] and plan.num_rounds > start:
+                # The final scheduled save was declined (e.g. another writer
+                # advanced the manager's latest_step past our sequence) and
+                # there was no later round to retry at — persist the
+                # terminal state at the next step the manager will accept.
+                final_r = plan.num_rounds - 1
+                latest_now = ckpt.latest_step()
+                step = max(final_r + step_offset,
+                           (-1 if latest_now is None else latest_now) + 1)
+                ckpt.save(step, state, wait=True, meta=_meta(final_r))
         except BaseException:
             # Close on failure too: orbax's background threads and the
             # metrics file handle must not leak across in-process retries.
@@ -314,16 +325,6 @@ class Trainer:
                     logger.close()
             raise
         if ckpt is not None:
-            if save_due[0] and plan.num_rounds > start:
-                # The final scheduled save was declined (e.g. another writer
-                # advanced the manager's latest_step past our sequence) and
-                # there was no later round to retry at — persist the terminal
-                # state at the next step the manager will accept.
-                final_r = plan.num_rounds - 1
-                latest_now = ckpt.latest_step()
-                step = max(final_r + step_offset,
-                           (-1 if latest_now is None else latest_now) + 1)
-                ckpt.save(step, state, wait=True, meta=_meta(final_r))
             ckpt.close()
         if logger is not None:
             logger.close()

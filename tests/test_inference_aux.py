@@ -104,9 +104,12 @@ def test_loss_evaluator():
 
 
 def test_metrics_logger_writes_jsonl(tmp_path):
+    import time
+
     path = str(tmp_path / "m.jsonl")
     logger = MetricsLogger(path, samples_per_round=128, num_chips=4)
     logger(0, 1.5)
+    time.sleep(0.002)  # distinct timing segments (see _BURST_EPS_S)
     logger(1, 1.2)
     logger.close()
     import json

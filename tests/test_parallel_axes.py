@@ -141,3 +141,30 @@ def test_flash_attention_under_tensor_parallelism():
         losses[impl] = (float(l0), float(l1))
     np.testing.assert_allclose(losses["flash"], losses["dense"], rtol=2e-3)
     assert losses["flash"][1] < losses["flash"][0]
+
+
+def test_gspmd_engine_rejects_flash_and_seq_axis_at_init():
+    """Unsupported combos must fail at construction with a pointer to
+    SPMDEngine, not as an opaque TPU trace-time mesh failure (the CPU
+    interpret mode would even mask it entirely)."""
+    import pytest
+
+    from distkeras_tpu.models.transformer import TransformerLM
+    from distkeras_tpu.parallel.gspmd import GSPMDEngine
+    from distkeras_tpu.runtime.mesh import hybrid_mesh
+
+    arch = dict(vocab_size=128, num_layers=1, d_model=32, num_heads=2,
+                d_ff=64, max_seq_len=16)
+    mesh = hybrid_mesh({"data": 4, "model": 2})
+    flash = Model.build(TransformerLM(**arch, attn_impl="flash"),
+                        jnp.zeros((1, 1), jnp.int32))
+    with pytest.raises(ValueError, match="SPMDEngine"):
+        GSPMDEngine(flash, "sgd", "sparse_categorical_crossentropy", mesh,
+                    TRANSFORMER_TP_RULES)
+    ringy = Model.build(TransformerLM(**arch), jnp.zeros((1, 1), jnp.int32))
+    ringy = Model(module=TransformerLM(**arch, seq_axis="seq",
+                                       attn_impl="ring"),
+                  params=ringy.params)
+    with pytest.raises(ValueError, match="SPMDEngine"):
+        GSPMDEngine(ringy, "sgd", "sparse_categorical_crossentropy", mesh,
+                    TRANSFORMER_TP_RULES)
