@@ -58,6 +58,10 @@ from distkeras_tpu.trainers import (  # noqa: F401
 )
 from distkeras_tpu.data import (  # noqa: F401
     DataFrame,
+    ShardedDataFrame,
+    ShardStore,
+    ShardWriter,
+    write_shards,
     DenseTransformer,
     LabelIndexTransformer,
     MinMaxTransformer,
@@ -89,6 +93,10 @@ __all__ = [
     "AveragingTrainer",
     "EnsembleTrainer",
     "DataFrame",
+    "ShardedDataFrame",
+    "ShardStore",
+    "ShardWriter",
+    "write_shards",
     "Transformer",
     "LabelIndexTransformer",
     "OneHotTransformer",

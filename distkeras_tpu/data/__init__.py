@@ -15,9 +15,21 @@ from distkeras_tpu.data.transformers import (  # noqa: F401
     Transformer,
 )
 from distkeras_tpu.data.batching import BatchPlan, make_batches  # noqa: F401
+from distkeras_tpu.data.shards import (  # noqa: F401
+    ShardedBatchPlan,
+    ShardedDataFrame,
+    ShardStore,
+    ShardWriter,
+    write_shards,
+)
 
 __all__ = [
     "DataFrame",
+    "ShardedDataFrame",
+    "ShardStore",
+    "ShardWriter",
+    "ShardedBatchPlan",
+    "write_shards",
     "Transformer",
     "LabelIndexTransformer",
     "OneHotTransformer",

@@ -82,7 +82,20 @@ def make_batches(
     Rows that don't fill a complete round are dropped (the reference likewise
     truncates trailing partial minibatches per partition). With ``shuffle`` each
     epoch gets an independent permutation, so dropped rows differ per epoch.
+
+    A :class:`~.shards.ShardedDataFrame` routes to the disk-backed planner
+    (``shards.make_sharded_batches``): same trainer call, out-of-core data
+    plane — rows stay on disk and each process stages only its own workers'
+    rows. Memmap-backed columns in a plain DataFrame also stay on disk
+    (``np.asarray`` of a memmap is a view): the single-host out-of-core case
+    needs no special type.
     """
+    if getattr(df, "is_sharded", False):
+        from distkeras_tpu.data.shards import make_sharded_batches
+
+        return make_sharded_batches(
+            df, features_col, label_col, batch_size, num_workers,
+            window=window, num_epoch=num_epoch, shuffle=shuffle, seed=seed)
     x = np.asarray(df[features_col])
     y = np.asarray(df[label_col])
     n = len(x)

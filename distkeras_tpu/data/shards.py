@@ -1,0 +1,400 @@
+"""Sharded, out-of-core columnar store — the Spark-DataFrame role at scale.
+
+The reference's data plane was a Spark DataFrame: *partitioned across executors
+and spillable to disk*, so no single host ever had to hold the full dataset
+(SURVEY.md §1 L1, external-substrate row). The in-RAM :class:`~.dataframe.DataFrame`
+covers the laptop/notebook case; this module covers the pod case the reference
+got from Spark — ImageNet-shaped data (BASELINE config #5: ~150 GB over 32+
+hosts) that cannot obey the "every process holds the identical full host value"
+contract of ``runtime/mesh.put_global``.
+
+Design (TPU-first, no JVM):
+
+* **On-disk layout** — plain ``.npy`` shard files per column plus a JSON
+  manifest. ``.npy`` means every reader is ``np.load(mmap_mode='r')``: gathers
+  touch only the pages they index, so a 100 GB column costs RAM proportional
+  to the rows *read this round*, not the dataset.
+* **Worker-contiguous partitioning** — worker ``w`` of ``W`` owns global rows
+  ``[w·(n//W), (w+1)·(n//W))``, mirroring Spark's ``repartition(num_workers)``
+  (each executor gets one contiguous partition). Shuffling permutes *within*
+  a worker's partition per epoch — the reference's per-partition minibatch
+  iteration, and exactly what keeps every row host-local.
+* **Per-host shard residency** — a process needs only the shard files
+  overlapping its own workers' row ranges. ``ShardStore`` memmaps shards
+  lazily and never opens files it is not asked to read, so hosts can hold
+  strictly disjoint subsets of the data directory.
+* **Per-round gather** — ``ShardedBatchPlan.round_local(r, workers)`` gathers
+  just the rows those workers consume in round ``r`` (native threaded gather
+  when built); the engine assembles the global device batch from each
+  process's local rows (``parallel/engine.stage_round``), replacing the
+  replicated-host-value contract with a "each process stages what its chips
+  eat" contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from collections import OrderedDict
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from distkeras_tpu.data.native_loader import gather_rows
+
+_MANIFEST = "manifest.json"
+
+
+def _shard_file(shard: int, col: str) -> str:
+    return f"shard-{shard:05d}.{col}.npy"
+
+
+class ShardWriter:
+    """Streaming writer: append row chunks, emit ``rows_per_shard``-row shard
+    files. Nothing is ever held beyond one shard's buffer, so a 100 GB dataset
+    can be written from a generator with bounded RAM (the ingest-side half of
+    the out-of-core contract)."""
+
+    def __init__(self, path: str, rows_per_shard: int):
+        if rows_per_shard < 1:
+            raise ValueError(f"rows_per_shard must be >= 1, got {rows_per_shard}")
+        self.path = path
+        self.rows_per_shard = int(rows_per_shard)
+        os.makedirs(path, exist_ok=True)
+        self._buf: dict[str, list[np.ndarray]] = {}
+        self._buffered = 0
+        self._shards: list[int] = []  # rows per emitted shard
+        self._meta: Optional[dict] = None
+        self._closed = False
+
+    def append(self, **columns: np.ndarray) -> None:
+        cols = {k: np.asarray(v) for k, v in columns.items()}
+        n = {len(v) for v in cols.values()}
+        if len(n) != 1:
+            raise ValueError(
+                f"column length mismatch: { {k: len(v) for k, v in cols.items()} }")
+        n = n.pop()
+        if self._meta is None:
+            self._meta = {
+                k: {"dtype": str(v.dtype), "shape": list(v.shape[1:])}
+                for k, v in cols.items()
+            }
+            self._buf = {k: [] for k in cols}
+        elif set(cols) != set(self._meta):
+            raise ValueError(
+                f"columns changed mid-stream: {sorted(cols)} vs {sorted(self._meta)}")
+        for k, v in cols.items():
+            m = self._meta[k]
+            if list(v.shape[1:]) != m["shape"] or str(v.dtype) != m["dtype"]:
+                raise ValueError(
+                    f"column {k!r}: got {v.dtype}{list(v.shape[1:])}, "
+                    f"expected {m['dtype']}{m['shape']}")
+            self._buf[k].append(v)
+        self._buffered += n
+        while self._buffered >= self.rows_per_shard:
+            self._flush(self.rows_per_shard)
+
+    def _flush(self, rows: int) -> None:
+        shard = len(self._shards)
+        for k, chunks in self._buf.items():
+            cat = np.concatenate(chunks) if len(chunks) > 1 else chunks[0]
+            np.save(os.path.join(self.path, _shard_file(shard, k)), cat[:rows])
+            self._buf[k] = [cat[rows:]] if rows < len(cat) else []
+        self._shards.append(rows)
+        self._buffered -= rows
+
+    def close(self) -> dict:
+        """Flush the tail shard and write the manifest; returns the manifest."""
+        if self._closed:
+            raise RuntimeError("ShardWriter already closed")
+        if self._buffered:
+            self._flush(self._buffered)
+        self._closed = True
+        offsets = np.concatenate([[0], np.cumsum(self._shards)]).tolist()
+        manifest = {
+            "version": 1,
+            "num_rows": int(offsets[-1]),
+            "columns": self._meta or {},
+            "shard_rows": [int(r) for r in self._shards],
+            "shard_offsets": [int(o) for o in offsets[:-1]],
+        }
+        with open(os.path.join(self.path, _MANIFEST), "w") as f:
+            json.dump(manifest, f)
+        return manifest
+
+
+def write_shards(path: str, columns: Mapping[str, np.ndarray],
+                 rows_per_shard: int) -> dict:
+    """One-shot convenience: shard in-RAM columns to ``path``."""
+    w = ShardWriter(path, rows_per_shard)
+    w.append(**dict(columns))
+    return w.close()
+
+
+class ShardStore:
+    """Reader over a shard directory: lazily memmapped, locality-honest.
+
+    ``gather(col, row_ids)`` opens only the shard files the ids land in — a
+    host holding a disjoint subset of the shards can serve every row it owns
+    and fails loudly (FileNotFoundError) on rows it does not, which is the
+    property the per-host data plane relies on (and tests assert)."""
+
+    #: open-memmap cap. Each memmap holds a file descriptor for its lifetime;
+    #: a ~150 GB store can span thousands of shard files, and an unbounded
+    #: cache would blow the default 1024-fd ulimit mid-epoch. LRU keeps the
+    #: hot working set (a round touches few shards) while bounding fds.
+    MAX_OPEN_MAPS = 128
+
+    def __init__(self, path: str, max_open_maps: Optional[int] = None):
+        self.path = path
+        with open(os.path.join(path, _MANIFEST)) as f:
+            m = json.load(f)
+        self.manifest = m
+        self.num_rows: int = m["num_rows"]
+        self.columns: dict = m["columns"]
+        self._offsets = np.asarray(m["shard_offsets"] + [m["num_rows"]], np.int64)
+        self._max_open = max_open_maps or self.MAX_OPEN_MAPS
+        self._maps: "OrderedDict[tuple[int, str], np.ndarray]" = OrderedDict()
+
+    @classmethod
+    def open(cls, path: str) -> "ShardStore":
+        return cls(path)
+
+    def count(self) -> int:
+        return self.num_rows
+
+    def column_spec(self, col: str) -> tuple[tuple, np.dtype]:
+        c = self.columns[col]
+        return tuple(c["shape"]), np.dtype(c["dtype"])
+
+    def shard_range(self, shard: int) -> tuple[int, int]:
+        return int(self._offsets[shard]), int(self._offsets[shard + 1])
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._offsets) - 1
+
+    def shards_for_rows(self, lo: int, hi: int) -> list[int]:
+        """Shard ids overlapping global row range ``[lo, hi)``."""
+        s0 = int(np.searchsorted(self._offsets, lo, side="right")) - 1
+        s1 = int(np.searchsorted(self._offsets, hi, side="left"))
+        return list(range(max(s0, 0), max(s1, 0)))
+
+    def _map(self, shard: int, col: str) -> np.ndarray:
+        key = (shard, col)
+        mm = self._maps.get(key)
+        if mm is None:
+            fp = os.path.join(self.path, _shard_file(shard, col))
+            mm = np.load(fp, mmap_mode="r")
+            while len(self._maps) >= self._max_open:
+                # Dropping the reference closes the underlying mmap + fd
+                # (gathers copy out of the map, so no views outlive it).
+                self._maps.popitem(last=False)
+            self._maps[key] = mm
+        else:
+            self._maps.move_to_end(key)
+        return mm
+
+    def close(self) -> None:
+        """Release every cached memmap (and its file descriptor)."""
+        self._maps.clear()
+
+    def gather(self, col: str, row_ids: np.ndarray) -> np.ndarray:
+        """``rows[row_ids]`` across shard files; result shape
+        ``row_ids.shape + row_shape``. Order-preserving."""
+        ids = np.asarray(row_ids)
+        flat = ids.reshape(-1).astype(np.int64)
+        if flat.size and (flat.min() < 0 or flat.max() >= self.num_rows):
+            raise IndexError(
+                f"row ids out of range [0, {self.num_rows}) for column {col!r}")
+        shape, dtype = self.column_spec(col)
+        out = np.empty((flat.size,) + shape, dtype)
+        shard_of = np.searchsorted(self._offsets, flat, side="right") - 1
+        for s in np.unique(shard_of):
+            sel = np.nonzero(shard_of == s)[0]
+            base = self._offsets[s]
+            # memmap-backed: the gather faults in only the touched pages.
+            out[sel] = gather_rows(self._map(int(s), col), flat[sel] - base)
+        return out.reshape(ids.shape + shape)
+
+
+class ShardedDataFrame:
+    """Trainer-facing handle over a :class:`ShardStore` — the drop-in for
+    ``Trainer.train(dataframe)`` at out-of-core scale. Row data stays on disk;
+    only per-round gathers materialize. Column transforms belong at ingest
+    time (``ShardWriter``), like Spark pipelines ran before ``repartition``."""
+
+    is_sharded = True
+
+    def __init__(self, store_or_path, num_partitions: Optional[int] = None):
+        self.store = (store_or_path if isinstance(store_or_path, ShardStore)
+                      else ShardStore.open(store_or_path))
+        self.num_partitions = num_partitions
+
+    @property
+    def columns(self) -> list[str]:
+        return list(self.store.columns)
+
+    def count(self) -> int:
+        return self.store.count()
+
+    def __len__(self) -> int:
+        return self.store.count()
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.store.columns
+
+    def repartition(self, n: int) -> "ShardedDataFrame":
+        return ShardedDataFrame(self.store, num_partitions=n)
+
+    def __getattr__(self, name):
+        if name in {"with_column", "select", "drop", "take_rows", "shuffle",
+                    "split", "random_split", "randomSplit", "iter_rows"}:
+            raise AttributeError(
+                f"ShardedDataFrame does not materialize rows; {name!r} is an "
+                "in-RAM DataFrame op. Apply transforms at ingest time "
+                "(ShardWriter) — training-time shuffling is the planner's job "
+                "(make_batches(..., shuffle=True) permutes within partitions).")
+        raise AttributeError(name)
+
+
+def worker_partition(num_rows: int, num_workers: int) -> list[tuple[int, int]]:
+    """Worker ``w``'s contiguous global row range (Spark repartition analogue).
+
+    Equal-sized ``n // W`` partitions; the remainder tail is dropped, matching
+    the in-RAM planner's drop of rows that don't fill a complete round."""
+    rpw = num_rows // num_workers
+    return [(w * rpw, (w + 1) * rpw) for w in range(num_workers)]
+
+
+def worker_major_index(
+    num_rows: int,
+    num_workers: int,
+    window: int,
+    batch_size: int,
+    num_epoch: int = 1,
+    shuffle: bool = False,
+    seed: int = 0,
+) -> np.ndarray:
+    """The sharded schedule: ``[rounds, W, K, B]`` global row ids where row
+    ``index[r, w]`` ⊂ worker ``w``'s contiguous partition for every round.
+
+    Deterministic in ``seed`` — every process computes the identical matrix,
+    which is what lets hosts stage disjoint data without coordination. With
+    ``shuffle``, each (epoch, worker) gets an independent permutation *within
+    the worker's partition* (per-partition shuffling, the Spark-era
+    semantics); rows beyond ``rounds_per_epoch·K·B`` differ per epoch."""
+    per_worker_round = window * batch_size
+    rpw = num_rows // num_workers
+    if rpw < per_worker_round:
+        raise ValueError(
+            f"each worker's partition has {rpw} rows but one round consumes "
+            f"window*batch_size = {per_worker_round}; shrink "
+            "batch_size/communication_window or add data")
+    rounds_per_epoch = rpw // per_worker_round
+    rng = np.random.default_rng(seed)
+    epochs = []
+    for _ in range(num_epoch):
+        per_w = []
+        for w in range(num_workers):
+            local = rng.permutation(rpw) if shuffle else np.arange(rpw)
+            per_w.append(
+                w * rpw
+                + local[: rounds_per_epoch * per_worker_round].reshape(
+                    rounds_per_epoch, window, batch_size))
+        epochs.append(np.stack(per_w, axis=1))  # [rounds, W, K, B]
+    return np.concatenate(epochs, axis=0)
+
+
+@dataclasses.dataclass
+class ShardedBatchPlan:
+    """A :class:`~.batching.BatchPlan`-shaped schedule whose rows live on disk.
+
+    Same engine-facing surface (``num_rounds``/``samples_per_round``/
+    ``round``), plus the locality contract: ``is_local=True`` tells the run
+    loop to stage per-process rows via :meth:`round_local` instead of the
+    full-host ``round`` gather (``parallel/engine.stage_round``)."""
+
+    store: ShardStore
+    features_col: str
+    label_col: str
+    index: np.ndarray  # [rounds, W, K, B] global row ids
+    num_workers: int
+    window: int
+    batch_size: int
+    rows_total: int
+
+    is_local = True
+
+    @property
+    def num_rounds(self) -> int:
+        return self.index.shape[0]
+
+    @property
+    def rows_used(self) -> int:
+        return int(self.index.size)
+
+    @property
+    def steps_per_worker(self) -> int:
+        return self.num_rounds * self.window
+
+    @property
+    def samples_per_round(self) -> int:
+        return self.num_workers * self.window * self.batch_size
+
+    def round(self, r: int) -> tuple[np.ndarray, np.ndarray]:
+        """Full ``[W, K, B, ...]`` gather — valid only where every shard is
+        present (single host, or a shared filesystem)."""
+        idx = self.index[r]
+        return (self.store.gather(self.features_col, idx),
+                self.store.gather(self.label_col, idx))
+
+    def round_local(self, r: int, workers: Sequence[int]
+                    ) -> tuple[np.ndarray, np.ndarray]:
+        """Rows for the given workers only: ``[len(workers), K, B, ...]``.
+        Touches only the shards overlapping those workers' partitions."""
+        idx = self.index[r][np.asarray(list(workers), np.int64)]
+        return (self.store.gather(self.features_col, idx),
+                self.store.gather(self.label_col, idx))
+
+    def local_shards(self, workers: Sequence[int]) -> list[int]:
+        """Shard ids a process hosting ``workers`` needs on local disk."""
+        parts = worker_partition(self.store.count(), self.num_workers)
+        shards: set[int] = set()
+        for w in workers:
+            lo, hi = parts[w]
+            shards.update(self.store.shards_for_rows(lo, hi))
+        return sorted(shards)
+
+
+def make_sharded_batches(
+    df,
+    features_col: str,
+    label_col: str,
+    batch_size: int,
+    num_workers: int,
+    window: int = 1,
+    num_epoch: int = 1,
+    shuffle: bool = False,
+    seed: int = 0,
+) -> ShardedBatchPlan:
+    """Plan ``num_epoch`` passes over a :class:`ShardedDataFrame` /
+    :class:`ShardStore` (the disk-backed twin of ``batching.make_batches``)."""
+    store = df.store if isinstance(df, ShardedDataFrame) else df
+    for col in (features_col, label_col):
+        if col not in store.columns:
+            raise KeyError(f"column {col!r} not in store ({list(store.columns)})")
+    index = worker_major_index(
+        store.count(), num_workers, window, batch_size,
+        num_epoch=num_epoch, shuffle=shuffle, seed=seed)
+    return ShardedBatchPlan(
+        store=store,
+        features_col=features_col,
+        label_col=label_col,
+        index=index,
+        num_workers=num_workers,
+        window=window,
+        batch_size=batch_size,
+        rows_total=store.count() * num_epoch,
+    )

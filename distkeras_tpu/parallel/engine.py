@@ -287,6 +287,77 @@ class AsyncEngine:
                           rounds_per_program)
 
 
+def local_worker_ids(mesh) -> list[int]:
+    """Global worker ids whose chips THIS process hosts (1-D data mesh).
+
+    The sharded data plane's unit of locality: a process stages rows for
+    exactly these workers (``stage_round``), so per-host disk shards follow
+    the device→process mapping with no extra bookkeeping."""
+    pi = jax.process_index()
+    return [w for w, d in enumerate(mesh.devices.flat) if d.process_index == pi]
+
+
+def put_worker_local(local, mesh, num_workers: int, local_workers: list[int],
+                     axis: int, spec):
+    """Assemble a global batch array from rows this process holds.
+
+    Replaces ``put_global``'s "every process holds the identical full host
+    value" contract for batches: ``local`` carries only ``local_workers``'s
+    slices along ``axis``; the callback answers each addressable device's
+    shard request by translating its global worker range to local positions.
+    Never sees (and so never requires) another host's rows."""
+    global_shape = local.shape[:axis] + (num_workers,) + local.shape[axis + 1:]
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1 and len(local_workers) == num_workers:
+        return jax.device_put(local, sharding)
+    pos = {w: i for i, w in enumerate(local_workers)}
+    def cb(idx):
+        sl = idx[axis]
+        start = 0 if sl.start is None else sl.start
+        stop = global_shape[axis] if sl.stop is None else sl.stop
+        li = [pos[w] for w in range(start, stop)]
+        if li != list(range(li[0], li[0] + len(li))):
+            raise ValueError(
+                f"non-contiguous local worker placement {li} unsupported")
+        key = list(idx)
+        key[axis] = slice(li[0], li[0] + len(li))
+        return local[tuple(key)]
+    return jax.make_array_from_callback(tuple(global_shape), sharding, cb)
+
+
+def stage_round(engine, plan, r: int):
+    """Gather + device-stage one round's batch, honouring plan locality.
+
+    In-RAM plans go through the engine's full-batch path; sharded plans
+    (``is_local``) gather only this process's workers' rows from disk and
+    assemble the global array from them."""
+    if getattr(plan, "is_local", False):
+        lw = local_worker_ids(engine.mesh)
+        xs, ys = plan.round_local(r, lw)
+        put = lambda a: put_worker_local(
+            a, engine.mesh, plan.num_workers, lw, 0, P(DATA_AXIS))
+        return put(xs), put(ys)
+    return engine._put_batch(*plan.round(r))
+
+
+def stage_block(engine, plan, rs) -> tuple:
+    """Stage a ``[R, W, K, B, ...]`` block of rounds (worker axis at dim 1)."""
+    spec = P(None, DATA_AXIS)
+    if getattr(plan, "is_local", False):
+        lw = local_worker_ids(engine.mesh)
+        batches = [plan.round_local(r, lw) for r in rs]
+        xs = np.stack([b[0] for b in batches])
+        ys = np.stack([b[1] for b in batches])
+        put = lambda a: put_worker_local(
+            a, engine.mesh, plan.num_workers, lw, 1, spec)
+        return put(xs), put(ys)
+    batches = [plan.round(r) for r in rs]
+    xs = np.stack([b[0] for b in batches])
+    ys = np.stack([b[1] for b in batches])
+    shard = NamedSharding(engine.mesh, spec)
+    return put_global(xs, shard), put_global(ys, shard)
+
+
 def run_rounds(engine, plan, state, start_round, on_round, rounds_per_program):
     """Dispatch to the per-round / blocked / auto-sized run loop (shared by the
     sync and async engines). ``rounds_per_program`` may be an int (fixed R) or
@@ -307,7 +378,7 @@ def run_per_round(engine, plan, state, start_round, on_round):
 
     losses = []
     feeder = RoundFeeder(plan.num_rounds,
-                         lambda r: engine._put_batch(*plan.round(r)),
+                         lambda r: stage_round(engine, plan, r),
                          start_round=start_round)
     try:
         for r, (xs, ys) in feeder:
@@ -393,7 +464,7 @@ def run_auto(engine, plan, state, start_round, on_round):
     round_bytes = 1
 
     # Round 1 fences compile (its callback runs inline — we're not timing yet).
-    xs, ys = engine._put_batch(*plan.round(r))
+    xs, ys = stage_round(engine, plan, r)
     state, loss = engine._round_fn(state, xs, ys)
     losses.append(loss)
     if on_round is not None:
@@ -414,7 +485,7 @@ def run_auto(engine, plan, state, start_round, on_round):
     n = 0
     t0 = _time.perf_counter()
     while r < plan.num_rounds and n < _AUTO_PROBE_ROUNDS:
-        xs, ys = engine._put_batch(*plan.round(r))
+        xs, ys = stage_round(engine, plan, r)
         round_bytes = sum(int(a.nbytes) for a in jax.tree.leaves((xs, ys)))
         state, loss = engine._round_fn(state, xs, ys)
         losses.append(loss)
@@ -463,15 +534,11 @@ def run_blocked(engine, plan, state, start_round, on_round, R):
     from distkeras_tpu.data.prefetch import RoundFeeder
 
     starts = list(range(start_round, plan.num_rounds, R))
-    # Blocked batches are [R, W, K, B, ...]: the worker axis moves to dim 1.
-    shard = NamedSharding(engine.mesh, P(None, DATA_AXIS))
 
     def stage(i):
+        # Blocked batches are [R, W, K, B, ...]: the worker axis moves to dim 1.
         rs = range(starts[i], min(starts[i] + R, plan.num_rounds))
-        batches = [plan.round(r) for r in rs]
-        xs = np.stack([b[0] for b in batches])
-        ys = np.stack([b[1] for b in batches])
-        return put_global(xs, shard), put_global(ys, shard)
+        return stage_block(engine, plan, rs)
 
     losses = []
     feeder = RoundFeeder(len(starts), stage)

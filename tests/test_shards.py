@@ -1,0 +1,283 @@
+"""Sharded / out-of-core data plane (VERDICT r2 missing #1).
+
+The reference's Spark DataFrame was partitioned across executors and spillable
+to disk; these tests pin the TPU-side replacement: ``.npy`` shard files +
+manifest, memmapped gathers that touch only the rows they index, a
+worker-contiguous schedule that keeps every row host-local, and engine staging
+that feeds a training run identical to the in-RAM path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from distkeras_tpu.data.batching import BatchPlan, make_batches
+from distkeras_tpu.data.dataframe import DataFrame
+from distkeras_tpu.data.shards import (
+    ShardStore,
+    ShardWriter,
+    ShardedDataFrame,
+    make_sharded_batches,
+    worker_major_index,
+    worker_partition,
+    write_shards,
+)
+
+
+def _blobs(n=512, d=4, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=4.0, size=(c, d))
+    y = rng.integers(0, c, size=n)
+    x = (centers[y] + rng.normal(scale=0.5, size=(n, d))).astype(np.float32)
+    return x, y.astype(np.int32)
+
+
+# ---------------------------------------------------------------- store I/O
+
+
+def test_write_shards_roundtrip(tmp_path):
+    x, y = _blobs(n=100)
+    write_shards(tmp_path, {"features": x, "label": y}, rows_per_shard=32)
+    store = ShardStore.open(tmp_path)
+    assert store.count() == 100
+    assert store.num_shards == 4  # 32+32+32+4
+    idx = np.array([[0, 99], [31, 32]])  # spans shard boundaries, 2-D idx
+    np.testing.assert_array_equal(store.gather("features", idx), x[idx])
+    np.testing.assert_array_equal(store.gather("label", idx), y[idx])
+
+
+def test_shard_writer_streaming_matches_oneshot(tmp_path):
+    """Appending in uneven chunks produces the same store as one-shot write."""
+    x, y = _blobs(n=90)
+    w = ShardWriter(tmp_path / "stream", rows_per_shard=25)
+    for lo, hi in [(0, 10), (10, 60), (60, 90)]:
+        w.append(features=x[lo:hi], label=y[lo:hi])
+    m = w.close()
+    assert m["num_rows"] == 90
+    assert m["shard_rows"] == [25, 25, 25, 15]
+    store = ShardStore.open(tmp_path / "stream")
+    np.testing.assert_array_equal(
+        store.gather("features", np.arange(90)), x)
+
+
+def test_writer_rejects_schema_drift(tmp_path):
+    w = ShardWriter(tmp_path, rows_per_shard=8)
+    w.append(features=np.zeros((4, 3), np.float32))
+    with pytest.raises(ValueError, match="columns changed"):
+        w.append(labels=np.zeros(4))
+    with pytest.raises(ValueError, match="expected float32"):
+        w.append(features=np.zeros((4, 3), np.float64))
+
+
+def test_gather_out_of_range(tmp_path):
+    x, y = _blobs(n=20)
+    write_shards(tmp_path, {"features": x, "label": y}, rows_per_shard=10)
+    store = ShardStore.open(tmp_path)
+    with pytest.raises(IndexError):
+        store.gather("features", np.array([20]))
+
+
+def test_locality_missing_shards_fail_only_when_touched(tmp_path):
+    """A host holding a subset of the shard files serves every row it owns
+    and fails loudly on rows it does not — the per-host residency contract."""
+    x, y = _blobs(n=80)
+    write_shards(tmp_path, {"features": x, "label": y}, rows_per_shard=20)
+    # Simulate a host that owns only shards 0-1 (rows 0..39).
+    for s in (2, 3):
+        os.remove(tmp_path / f"shard-{s:05d}.features.npy")
+        os.remove(tmp_path / f"shard-{s:05d}.label.npy")
+    store = ShardStore.open(tmp_path)
+    np.testing.assert_array_equal(
+        store.gather("features", np.arange(40)), x[:40])
+    with pytest.raises(FileNotFoundError):
+        store.gather("features", np.array([45]))
+
+
+def test_store_bounds_open_memmaps(tmp_path):
+    """The memmap cache is LRU-bounded: a store with more shards than the cap
+    never holds more than ``max_open_maps`` file descriptors."""
+    x = np.arange(64, dtype=np.float32).reshape(64, 1)
+    write_shards(tmp_path, {"features": x}, rows_per_shard=4)  # 16 shards
+    store = ShardStore(tmp_path, max_open_maps=3)
+    np.testing.assert_array_equal(
+        store.gather("features", np.arange(64)), x)  # touches all 16 shards
+    assert len(store._maps) <= 3
+    store.close()
+    assert not store._maps
+
+
+# ------------------------------------------------------------- the schedule
+
+
+def test_worker_major_index_partition_locality():
+    """Every round's rows for worker w stay inside w's contiguous partition —
+    the invariant that makes disjoint per-host shards possible at all."""
+    n, W, K, B = 512, 4, 2, 8
+    idx = worker_major_index(n, W, K, B, num_epoch=3, shuffle=True, seed=7)
+    parts = worker_partition(n, W)
+    assert idx.shape[1:] == (W, K, B)
+    for w, (lo, hi) in enumerate(parts):
+        rows = idx[:, w]
+        assert rows.min() >= lo and rows.max() < hi
+    # Within one epoch, no row is repeated for a worker (a true permutation).
+    rounds_per_epoch = idx.shape[0] // 3
+    epoch0 = idx[:rounds_per_epoch, 0].reshape(-1)
+    assert len(np.unique(epoch0)) == len(epoch0)
+
+
+def test_worker_major_index_deterministic():
+    a = worker_major_index(256, 2, 2, 4, shuffle=True, seed=3)
+    b = worker_major_index(256, 2, 2, 4, shuffle=True, seed=3)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_sharded_plan_round_matches_local(tmp_path):
+    x, y = _blobs(n=256)
+    write_shards(tmp_path, {"features": x, "label": y}, rows_per_shard=64)
+    plan = make_sharded_batches(
+        ShardedDataFrame(tmp_path), "features", "label",
+        batch_size=8, num_workers=4, window=2, shuffle=True, seed=1)
+    xs, ys = plan.round(0)
+    assert xs.shape == (4, 2, 8, 4)
+    xl, yl = plan.round_local(0, [1, 2])
+    np.testing.assert_array_equal(xl, xs[1:3])
+    np.testing.assert_array_equal(yl, ys[1:3])
+    # local_shards: worker partitions map to whole shards (64 rows each here).
+    assert plan.local_shards([0]) == [0]
+    assert plan.local_shards([2, 3]) == [2, 3]
+
+
+def test_sharded_dataframe_blocks_in_ram_ops(tmp_path):
+    x, y = _blobs(n=64)
+    write_shards(tmp_path, {"features": x, "label": y}, rows_per_shard=32)
+    sdf = ShardedDataFrame(tmp_path)
+    assert sdf.count() == 64 and "features" in sdf
+    with pytest.raises(AttributeError, match="ingest time"):
+        sdf.shuffle()
+
+
+# ----------------------------------------------------- training equivalence
+
+
+def _train_sync(df, num_workers=4, rounds_per_program=1):
+    from distkeras_tpu import SynchronousDistributedTrainer
+    from distkeras_tpu.models import Model
+    from distkeras_tpu.models.mlp import MLP
+
+    model = Model.build(MLP(hidden=(16,), num_outputs=3),
+                        np.zeros((1, 4), np.float32), seed=0)
+    t = SynchronousDistributedTrainer(
+        model, loss="sparse_categorical_crossentropy",
+        num_workers=num_workers, batch_size=8, num_epoch=2,
+        learning_rate=0.1, steps_per_program=4,
+        rounds_per_program=rounds_per_program)
+    trained = t.train(df)
+    return trained, t
+
+
+def test_sharded_training_matches_in_ram_same_schedule(tmp_path):
+    """A sharded-store run must produce bit-equal training to an in-RAM run
+    with the identical index matrix: staging path changes, semantics don't."""
+    x, y = _blobs(n=512)
+    write_shards(tmp_path, {"features": x, "label": y}, rows_per_shard=64)
+    sdf = ShardedDataFrame(tmp_path)
+
+    trained_s, ts = _train_sync(sdf)
+
+    # In-RAM plan with the same worker-major schedule, run via the engine.
+    from distkeras_tpu.models import Model
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.parallel.sync import SyncEngine
+    from distkeras_tpu.runtime.mesh import data_mesh
+
+    plan_s = make_sharded_batches(sdf, "features", "label", batch_size=8,
+                                  num_workers=4, window=4, num_epoch=2)
+    ram_plan = BatchPlan(x=x, y=y, index=plan_s.index, num_workers=4,
+                         window=4, batch_size=8, rows_total=512 * 2)
+    model = Model.build(MLP(hidden=(16,), num_outputs=3),
+                        np.zeros((1, 4), np.float32), seed=0)
+    eng = SyncEngine(model, "sgd", "sparse_categorical_crossentropy",
+                     data_mesh(num_workers=4), learning_rate=0.1)
+    state, losses = eng.run(ram_plan)
+
+    for a, b in zip(jax.tree.leaves(trained_s.params),
+                    jax.tree.leaves(state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    np.testing.assert_allclose(ts.get_history(), np.asarray(losses), rtol=1e-6)
+
+
+def test_sharded_training_blocked_matches_per_round(tmp_path):
+    """rounds_per_program>1 must stage blocked sharded batches identically."""
+    x, y = _blobs(n=512)
+    write_shards(tmp_path, {"features": x, "label": y}, rows_per_shard=128)
+    t1 = _train_sync(ShardedDataFrame(tmp_path), rounds_per_program=1)[1]
+    t4 = _train_sync(ShardedDataFrame(tmp_path), rounds_per_program=4)[1]
+    np.testing.assert_allclose(t1.get_history(), t4.get_history(), rtol=1e-6)
+
+
+def test_async_trainer_on_sharded_store_converges(tmp_path):
+    from distkeras_tpu import ADAG
+    from distkeras_tpu.models import Model
+    from distkeras_tpu.models.mlp import MLP
+
+    x, y = _blobs(n=1024)
+    write_shards(tmp_path, {"features": x, "label": y}, rows_per_shard=256)
+    model = Model.build(MLP(hidden=(16,), num_outputs=3),
+                        np.zeros((1, 4), np.float32), seed=0)
+    t = ADAG(model, loss="sparse_categorical_crossentropy", num_workers=4,
+             batch_size=8, num_epoch=3, learning_rate=0.1,
+             communication_window=4)
+    trained = t.train(ShardedDataFrame(tmp_path))
+    logits = np.asarray(trained.predict(x))
+    assert (logits.argmax(-1) == y).mean() > 0.85
+    assert t.get_history()[-1] < t.get_history()[0]
+
+
+# ------------------------------------------------------------- out-of-core
+
+
+def test_memmap_dataframe_stays_on_disk(tmp_path):
+    """The single-host out-of-core path: a DataFrame over memmap columns goes
+    through make_batches without copying the data (views all the way down)."""
+    x, y = _blobs(n=256)
+    np.save(tmp_path / "x.npy", x)
+    np.save(tmp_path / "y.npy", y)
+    mx = np.load(tmp_path / "x.npy", mmap_mode="r")
+    my = np.load(tmp_path / "y.npy", mmap_mode="r")
+    df = DataFrame({"features": mx, "label": my})
+    plan = make_batches(df, "features", "label", batch_size=8, num_workers=4,
+                        window=2)
+    assert np.shares_memory(plan.x, mx)  # no hidden materialization
+    xs, _ = plan.round(0)
+    np.testing.assert_array_equal(xs, x[plan.index[0]])
+
+
+def test_virtual_huge_dataset_feeds_from_disk(tmp_path):
+    """An ImageNet-shaped virtual dataset (sparse file, 64 GiB logical) feeds
+    training rounds while only the touched rows' pages ever materialize —
+    the BASELINE #5 shape that broke the full-RAM contract."""
+    n, h, w, c = 70_000, 224, 224, 3  # ~42 GiB of float32 features
+    feat_path = str(tmp_path / "feat.dat")
+    feats = np.memmap(feat_path, np.float32, mode="w+", shape=(n, h, w, c))
+    # Write only a handful of rows; the rest stay unallocated (sparse).
+    touched = [0, 1, 69_999]
+    for i in touched:
+        feats[i, 0, 0, 0] = float(i)
+    feats.flush()
+    labels = np.zeros(n, np.int32)
+    # The file is sparse: logical size huge, allocated blocks tiny.
+    st = os.stat(feat_path)
+    assert st.st_size == n * h * w * c * 4
+    assert st.st_blocks * 512 < 64 * 1024 * 1024, "file unexpectedly dense"
+
+    df = DataFrame({"features": np.memmap(feat_path, np.float32, mode="r",
+                                          shape=(n, h, w, c)),
+                    "label": labels})
+    plan = make_batches(df, "features", "label", batch_size=2, num_workers=4,
+                        window=1)
+    xs, ys = plan.round(0)  # gathers 8 rows = ~4.6 MB, not 42 GiB
+    assert xs.shape == (4, 1, 2, h, w, c)
+    assert xs[0, 0, 0, 0, 0, 0] == 0.0 and ys.shape == (4, 1, 2)
