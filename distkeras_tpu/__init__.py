@@ -52,9 +52,11 @@ from distkeras_tpu.trainers import (  # noqa: F401
     DynSGD,
     EAMSGD,
     EnsembleTrainer,
+    ParallelTrainer,
     SingleTrainer,
     SynchronousDistributedTrainer,
     Trainer,
+    TransformerTrainer,
 )
 from distkeras_tpu.data import (  # noqa: F401
     DataFrame,
@@ -92,6 +94,8 @@ __all__ = [
     "EAMSGD",
     "AveragingTrainer",
     "EnsembleTrainer",
+    "ParallelTrainer",
+    "TransformerTrainer",
     "DataFrame",
     "ShardedDataFrame",
     "ShardStore",

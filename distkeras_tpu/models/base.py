@@ -109,6 +109,12 @@ class Model:
     def with_state(self, state) -> "Model":
         return dataclasses.replace(self, state=state)
 
+    def with_module(self, module) -> "Model":
+        """Same params under a differently-configured module (e.g. rebinding
+        a TransformerLM with ``seq_axis`` set for sequence parallelism —
+        hyperparameter-only clones share the parameter structure)."""
+        return dataclasses.replace(self, module=module)
+
     @property
     def state_collections(self) -> tuple:
         """Names of the mutable collections (() for pure models)."""

@@ -329,9 +329,11 @@ def stage_round(engine, plan, r: int):
     """Gather + device-stage one round's batch, honouring plan locality.
 
     In-RAM plans go through the engine's full-batch path; sharded plans
-    (``is_local``) gather only this process's workers' rows from disk and
-    assemble the global array from them."""
-    if getattr(plan, "is_local", False):
+    (``is_local``) on a multi-process mesh gather only this process's
+    workers' rows from disk and assemble the global array from them.
+    Single-process, the full ``round`` gather IS the local gather (every
+    shard is addressable), so the plain path serves both."""
+    if getattr(plan, "is_local", False) and jax.process_count() > 1:
         lw = local_worker_ids(engine.mesh)
         xs, ys = plan.round_local(r, lw)
         put = lambda a: put_worker_local(
@@ -343,7 +345,13 @@ def stage_round(engine, plan, r: int):
 def stage_block(engine, plan, rs) -> tuple:
     """Stage a ``[R, W, K, B, ...]`` block of rounds (worker axis at dim 1)."""
     spec = P(None, DATA_AXIS)
-    if getattr(plan, "is_local", False):
+    if hasattr(engine, "_put_block"):
+        # Step-engine adapters shard the batch axis, not a worker axis —
+        # the engine owns its block spec (see parallel/runner.py).
+        batches = [plan.round(r) for r in rs]
+        return engine._put_block(np.stack([b[0] for b in batches]),
+                                 np.stack([b[1] for b in batches]))
+    if getattr(plan, "is_local", False) and jax.process_count() > 1:
         lw = local_worker_ids(engine.mesh)
         batches = [plan.round_local(r, lw) for r in rs]
         xs = np.stack([b[0] for b in batches])

@@ -105,13 +105,23 @@ class GSPMDEngine:
             params = optax.apply_updates(state.params, updates)
             return GSPMDState(params, opt_state, rng), loss
 
+        self._step_core = step  # unjitted: scannable by WindowedStepEngine
         self._step = jax.jit(step, donate_argnums=(0,))
 
     def init_state(self) -> GSPMDState:
+        from distkeras_tpu.parallel.sharding import mirror_tree_specs
+
         params = jax.tree.map(lambda a: np.array(a), self.model.params)
         shardings = param_shardings(params, self.mesh, self.rules)
         params = put_global(params, shardings)
-        opt_state = jax.jit(self.tx.init)(params)
+        # Explicit out_shardings: moments inherit the param layout, scalars
+        # replicate. Without it the state comes back committed to one device
+        # — fine under lazy resharding, but a checkpoint-restore template
+        # built from it collides with the mesh-sharded params at dispatch.
+        opt_sh = mirror_tree_specs(
+            jax.eval_shape(self.tx.init, params), params, shardings,
+            NamedSharding(self.mesh, P()))
+        opt_state = jax.jit(self.tx.init, out_shardings=opt_sh)(params)
         rng = put_global(jax.random.key(self.seed),
                           NamedSharding(self.mesh, P()))
         return GSPMDState(params, opt_state, rng)

@@ -105,6 +105,24 @@ class PipelineEngine:
         )
         self.tl = tl
         self.compute_dtype = compute_dtype
+        # Optimizer-state specs: moments mirror the (rep, stage) param split
+        # — stage moments sharded over ``pipe`` like the stage params, counts
+        # replicated. A pytree-prefix spec cannot express this (the moments
+        # are nested inside optax's chain tuple), and getting it wrong breaks
+        # any stateful optimizer: a replicated spec hands every stage the
+        # full moment stack while its update is stage-local, so the scan
+        # carry types diverge (adam failed exactly this way).
+        from distkeras_tpu.parallel.sharding import mirror_tree_specs
+
+        # All abstract (eval_shape): no host copy / device stack is ever
+        # materialized just to derive spec shapes.
+        split = lambda p: split_transformer_params(p, self.num_stages)
+        rep_a, stage_a = jax.eval_shape(split, model.params)
+        param_specs = (jax.tree.map(lambda _: P(), rep_a),
+                       jax.tree.map(lambda _: P(PIPE_AXIS), stage_a))
+        self._opt_specs = mirror_tree_specs(
+            jax.eval_shape(lambda p: self.tx.init(split(p)), model.params),
+            (rep_a, stage_a), param_specs, P())
         self._step = self._build_step()
 
     # -- pure functions ----------------------------------------------------
@@ -172,9 +190,9 @@ class PipelineEngine:
         mapped = shard_map(
             body,
             mesh=self.mesh,
-            in_specs=(P(), P(PIPE_AXIS), (P(), P(PIPE_AXIS)), P(),
+            in_specs=(P(), P(PIPE_AXIS), self._opt_specs, P(),
                       P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=(P(), P(PIPE_AXIS), (P(), P(PIPE_AXIS)), P(), P()),
+            out_specs=(P(), P(PIPE_AXIS), self._opt_specs, P(), P()),
             check_vma=False,
         )
 
@@ -185,6 +203,7 @@ class PipelineEngine:
             )
             return PipeState((rep, stage), opt_state, rng), loss
 
+        self._step_core = step  # unjitted: scannable by WindowedStepEngine
         return jax.jit(step, donate_argnums=(0,))
 
     # -- state -------------------------------------------------------------
@@ -195,7 +214,10 @@ class PipelineEngine:
         stage_sh = NamedSharding(self.mesh, P(PIPE_AXIS))
         rep = put_global(rep, rep_sh)
         stage = put_global(stage, stage_sh)
-        opt_state = jax.jit(self.tx.init)((rep, stage))
+        opt_sh = jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                              self._opt_specs,
+                              is_leaf=lambda x: isinstance(x, P))
+        opt_state = jax.jit(self.tx.init, out_shardings=opt_sh)((rep, stage))
         rng = put_global(jax.random.key(self.seed), rep_sh)
         return PipeState((rep, stage), opt_state, rng)
 

@@ -13,6 +13,7 @@ import re
 from typing import Sequence
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distkeras_tpu.runtime.mesh import EXPERT_AXIS, MODEL_AXIS
@@ -60,6 +61,36 @@ def param_path_specs(params, rules: Sequence[tuple[str, P]]):
         return P()
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def mirror_tree_specs(opt_tree, params, like, default):
+    """Per-leaf specs for an optimizer state: sub-trees that mirror ``params``
+    (adam moments, momentum traces) inherit ``like`` (a params-shaped tree of
+    specs/shardings); everything else (step counts, scalars) gets ``default``.
+
+    Matching is structural (treedef equality) plus shape agreement, so it is
+    optimizer-agnostic — no assumptions about optax's chain layout. Needed
+    because ``jax.jit(tx.init)`` alone leaves the state committed to one
+    device (restore-template mismatch) and because pytree-prefix specs cannot
+    address moments nested inside an optax chain tuple."""
+    import jax.tree_util as jtu
+
+    pdef = jtu.tree_structure(params)
+    pshapes = [np.shape(l) for l in jtu.tree_leaves(params)]
+
+    def rec(node):
+        if jtu.tree_structure(node) == pdef and [
+            np.shape(l) for l in jtu.tree_leaves(node)
+        ] == pshapes:
+            return like
+        not_self = lambda x: x is not node  # one-level flatten
+        onelevel = jtu.tree_structure(node, is_leaf=not_self)
+        children = jtu.tree_leaves(node, is_leaf=not_self)
+        if children == [node]:  # node is itself a leaf
+            return default
+        return jtu.tree_unflatten(onelevel, [rec(c) for c in children])
+
+    return rec(opt_tree)
 
 
 def param_shardings(params, mesh: Mesh, rules: Sequence[tuple[str, P]]):

@@ -146,13 +146,21 @@ class SPMDEngine:
             )
             return SPMDState(params, opt_state, rng), loss
 
+        self._step_core = step  # unjitted: scannable by WindowedStepEngine
         return jax.jit(step, donate_argnums=(0,))
 
     def init_state(self) -> SPMDState:
+        from distkeras_tpu.parallel.sharding import mirror_tree_specs
+
         params = jax.tree.map(lambda a: np.array(a), self.model.params)
         shardings = param_shardings(params, self.mesh, self.tp_rules)
         params = put_global(params, shardings)
-        opt_state = jax.jit(self.tx.init)(params)  # inherits param shardings
+        # Moments inherit param shardings, scalars replicate (see
+        # GSPMDEngine.init_state for why this must be explicit).
+        opt_sh = mirror_tree_specs(
+            jax.eval_shape(self.tx.init, params), params, shardings,
+            NamedSharding(self.mesh, P()))
+        opt_state = jax.jit(self.tx.init, out_shardings=opt_sh)(params)
         rng = put_global(
             jax.random.key(self.seed), NamedSharding(self.mesh, P())
         )

@@ -1,7 +1,10 @@
 """Flagship multi-axis training: TransformerLM on a (data, seq, model) mesh.
 
-dp x sp x tp in one jitted step — ring attention over ``seq``, gradient pmean over
-``data``, GSPMD tensor parallelism over ``model``. Dry-run anywhere:
+dp x sp x tp in one jitted step — ring attention over ``seq``, gradient pmean
+over ``data``, GSPMD tensor parallelism over ``model`` — through the same
+one-class trainer UX as every reference algorithm: ``ParallelTrainer`` wires
+the SPMD engine into the full run harness (checkpoint/resume, metrics JSONL,
+``rounds_per_program``). Dry-run anywhere:
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/transformer_spmd.py --steps 20
@@ -11,14 +14,13 @@ import argparse
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
+from distkeras_tpu import ParallelTrainer
 from distkeras_tpu.datasets import synthetic_lm
 from distkeras_tpu.models.base import Model
 from distkeras_tpu.models.transformer import TransformerLM
-from distkeras_tpu.parallel.sharding import TRANSFORMER_TP_RULES
-from distkeras_tpu.parallel.spmd import SPMDEngine, spmd_mesh_for
-from distkeras_tpu.runtime.mesh import DATA_AXIS, SEQ_AXIS
+from distkeras_tpu.parallel.spmd import spmd_mesh_for
+from distkeras_tpu.runtime.mesh import SEQ_AXIS
 
 
 def main():
@@ -29,34 +31,44 @@ def main():
     p.add_argument("--seq-len", type=int, default=128)
     p.add_argument("--vocab", type=int, default=512)
     p.add_argument("--batch-per-dp", type=int, default=4)
+    p.add_argument("--checkpoint-dir", default=None)
     args = p.parse_args()
 
-    mesh = spmd_mesh_for(jax.device_count())
-    print("mesh:", dict(mesh.shape))
+    # Factor the chips into (data, seq, model) — the same split the engine
+    # would get from spmd_mesh_for; expressed as the trainer's `parallel` map.
+    shape = dict(spmd_mesh_for(jax.device_count()).shape)
+    print("mesh:", shape)
 
-    arch = dict(vocab_size=args.vocab, num_layers=args.layers, d_model=args.d_model,
-                num_heads=4, d_ff=4 * args.d_model, max_seq_len=args.seq_len)
+    arch = dict(vocab_size=args.vocab, num_layers=args.layers,
+                d_model=args.d_model, num_heads=4, d_ff=4 * args.d_model,
+                max_seq_len=args.seq_len)
     model = Model.build(TransformerLM(**arch),
                         jnp.zeros((1, args.seq_len), jnp.int32))
-    model = Model(module=TransformerLM(**arch, seq_axis=SEQ_AXIS, attn_impl="ring"),
-                  params=model.params)
+    # Ring attention streams K/V blocks around the ICI ring over `seq`.
+    model = model.with_module(
+        TransformerLM(**arch, seq_axis=SEQ_AXIS, attn_impl="ring"))
     print(f"params: {model.num_params:,}")
 
-    engine = SPMDEngine(model, "adam", "sparse_categorical_crossentropy", mesh,
-                        TRANSFORMER_TP_RULES, learning_rate=3e-3)
-    state = engine.init_state()
-
-    B = args.batch_per_dp * mesh.shape[DATA_AXIS]
+    B = args.batch_per_dp * shape["data"]
     df = synthetic_lm(n=B * args.steps, vocab_size=args.vocab,
                       seq_len=args.seq_len + 1)
-    sharding = engine.batch_sharding()
-    for step in range(args.steps):
-        rows = slice(step * B, (step + 1) * B)
-        tokens = jax.device_put(jnp.asarray(df["features"][rows]), sharding)
-        targets = jax.device_put(jnp.asarray(df["label"][rows]), sharding)
-        state, loss = engine.step(state, tokens, targets)
-        if step % 10 == 0 or step == args.steps - 1:
-            print(f"step {step}: loss {float(loss):.4f}")
+
+    trainer = ParallelTrainer(
+        model, parallel=shape,
+        worker_optimizer="adam", loss="sparse_categorical_crossentropy",
+        batch_size=B, learning_rate=3e-3, steps_per_program=4,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=4 if args.checkpoint_dir else 0,
+        resume=bool(args.checkpoint_dir),
+        on_round=lambda r, loss: print(f"round {r}: loss {float(loss):.4f}"),
+    )
+    trainer.train(df)
+    h = trainer.get_history()
+    if len(h):
+        print(f"trained in {trainer.get_training_time():.1f}s; "
+              f"loss {h[0]:.4f} -> {h[-1]:.4f}")
+    else:  # resumed a checkpoint already past the final round
+        print("checkpoint already covers every round; nothing to train")
 
 
 if __name__ == "__main__":
