@@ -12,36 +12,83 @@ from __future__ import annotations
 
 from typing import Any
 
+import jax
 from flax import linen as nn
 
 from distkeras_tpu.models.base import DKModule, Model, register_model
+
+
+class GN(nn.Module):
+    """GroupNorm with a fused-kernel option (and optionally fused ReLU).
+
+    ``impl='pallas'`` routes to the one-pass Pallas kernel
+    (``ops/pallas/groupnorm.py``): stats + normalize + affine + ReLU on a
+    single HBM read/write — ResNet-class training here is bandwidth-bound and
+    GroupNorm is ~28% of the step (docs/PERFORMANCE.md). ``impl='xla'`` is
+    flax's ``nn.GroupNorm`` (+ separate relu), numerically equivalent."""
+
+    num_groups: int
+    impl: str = "xla"
+    relu: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        import jax.numpy as jnp
+
+        C = x.shape[-1]
+        # One param layout for both impls, so impl is a runtime choice (a
+        # checkpoint trained either way loads under the other).
+        gamma = self.param("scale", nn.initializers.ones, (C,))
+        beta = self.param("bias", nn.initializers.zeros, (C,))
+        # is_initializing: flax init may run eagerly on a CPU device even in
+        # a TPU process (param init is host work) — the compiled kernel can't;
+        # both impls share the param layout, so init through the HLO path.
+        if self.impl == "pallas" and not self.is_initializing():
+            from distkeras_tpu.ops.pallas.groupnorm import group_norm
+
+            return group_norm(x, gamma, beta, groups=self.num_groups,
+                              relu=self.relu,
+                              interpret=jax.default_backend() != "tpu")
+        # Functional GroupNorm, flax-equivalent: float32 stats over
+        # (spatial..., C/G) with biased variance, eps 1e-6.
+        G = self.num_groups
+        xf = x.astype(jnp.float32)
+        gshape = x.shape[:-1] + (G, C // G)
+        xg = xf.reshape(gshape)
+        axes = tuple(range(1, len(gshape) - 2)) + (len(gshape) - 1,)
+        mean = xg.mean(axes, keepdims=True)
+        var = ((xg - mean) ** 2).mean(axes, keepdims=True)
+        y = ((xg - mean) * jax.lax.rsqrt(var + 1e-6)).reshape(x.shape)
+        y = y * gamma + beta
+        if self.relu:
+            y = jnp.maximum(y, 0.0)
+        return y.astype(x.dtype)
 
 
 class BottleneckBlock(nn.Module):
     features: int
     strides: int = 1
     groups: int = 32
+    norm_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = nn.Conv(self.features, (1, 1), use_bias=False)(x)
-        y = nn.GroupNorm(num_groups=min(self.groups, self.features))(y)
-        y = nn.relu(y)
+        y = GN(min(self.groups, self.features), self.norm_impl, relu=True)(y)
         y = nn.Conv(
             self.features, (3, 3), strides=(self.strides, self.strides),
             padding="SAME", use_bias=False,
         )(y)
-        y = nn.GroupNorm(num_groups=min(self.groups, self.features))(y)
-        y = nn.relu(y)
+        y = GN(min(self.groups, self.features), self.norm_impl, relu=True)(y)
         y = nn.Conv(self.features * 4, (1, 1), use_bias=False)(y)
-        y = nn.GroupNorm(num_groups=min(self.groups, self.features * 4))(y)
+        y = GN(min(self.groups, self.features * 4), self.norm_impl)(y)
         if residual.shape != y.shape:
             residual = nn.Conv(
                 self.features * 4, (1, 1), strides=(self.strides, self.strides),
                 use_bias=False,
             )(x)
-            residual = nn.GroupNorm(num_groups=min(self.groups, self.features * 4))(residual)
+            residual = GN(min(self.groups, self.features * 4), self.norm_impl)(residual)
         return nn.relu(residual + y)
 
 
@@ -52,27 +99,42 @@ class ResNet(DKModule):
     num_outputs: int = 1000
     stem_kernel: int = 7
     groups: int = 32
+    #: jax.checkpoint each bottleneck block: activations are recomputed in
+    #: backward instead of saved, cutting peak HBM ~3x on the 224x224 stack —
+    #: what buys the larger per-chip batch the MXU needs to stay busy
+    #: (ImageNet ResNet is HBM-bound at small B; see docs/PERFORMANCE.md).
+    remat: bool = False
+    #: 'pallas' = fused one-pass GroupNorm(+ReLU) kernels; 'xla' = plain HLO.
+    norm_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         k = (self.stem_kernel, self.stem_kernel)
         x = nn.Conv(self.base_features, k, strides=(2, 2), padding="SAME", use_bias=False)(x)
-        x = nn.GroupNorm(num_groups=min(self.groups, self.base_features))(x)
-        x = nn.relu(x)
+        x = GN(min(self.groups, self.base_features), self.norm_impl,
+               relu=True)(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        block_cls = nn.remat(BottleneckBlock) if self.remat else BottleneckBlock
         for i, block_count in enumerate(self.stage_sizes):
             features = self.base_features * (2**i)
             for j in range(block_count):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = BottleneckBlock(features, strides=strides, groups=self.groups)(x)
+                # Explicit names: nn.remat changes the auto-generated module
+                # prefix, which would silently re-draw init and orphan
+                # checkpoints across remat settings.
+                x = block_cls(features, strides=strides, groups=self.groups,
+                              norm_impl=self.norm_impl,
+                              name=f"stage{i}_block{j}")(x)
         x = x.mean(axis=(1, 2))  # global average pool
         return nn.Dense(self.num_outputs)(x)
 
 
-def resnet50(num_outputs: int = 1000, seed: int = 0) -> Model:
+def resnet50(num_outputs: int = 1000, seed: int = 0, remat: bool = False,
+             norm_impl: str = "xla") -> Model:
     import jax.numpy as jnp
 
-    module = ResNet(stage_sizes=(3, 4, 6, 3), num_outputs=num_outputs)
+    module = ResNet(stage_sizes=(3, 4, 6, 3), num_outputs=num_outputs,
+                    remat=remat, norm_impl=norm_impl)
     return Model.build(module, jnp.zeros((1, 224, 224, 3), jnp.float32), seed=seed)
 
 

@@ -581,6 +581,12 @@ class ParallelTrainer(Trainer):
         **kwargs,
     ):
         super().__init__(model, **kwargs)
+        if self.grad_accum != 1:
+            raise ValueError(
+                "ParallelTrainer does not support grad_accum: the step "
+                "engines have no accumulation path, so the kwarg would be "
+                "silently ignored. Raise batch_size (the engines shard it "
+                "over the data axis) or use a data-parallel trainer.")
         self.parallel = dict(parallel) if parallel else {"data": -1}
         if "data" not in self.parallel:
             self.parallel = {"data": 1, **self.parallel}

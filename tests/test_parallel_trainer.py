@@ -50,6 +50,8 @@ def test_strategy_resolution():
     assert t._resolve_strategy() == "gspmd"
     with pytest.raises(ValueError, match="strategy"):
         _trainer({"data": -1}, strategy="nope")
+    with pytest.raises(ValueError, match="grad_accum"):
+        _trainer({"data": -1}, grad_accum=4)
 
 
 def test_gspmd_tp_trains_and_logs_metrics(tmp_path):
