@@ -6,6 +6,13 @@ the pure-functional replica model the async disciplines rely on and (b) couples
 statistics to the per-chip batch slice under data parallelism. GroupNorm is
 batch-independent, needs no state collection, and is the standard TPU-scale substitute
 (same accuracy class at ResNet-50 scale).
+
+Param-naming note (round 3): blocks are explicitly named ``stage{i}_block{j}``
+and norms ``GN_k`` — a ONE-TIME break from the earlier auto-generated
+``BottleneckBlock_i/GroupNorm_k`` paths, required so ``remat=True`` (which
+changes flax's auto prefix) cannot silently re-draw init or orphan
+checkpoints across remat settings. Checkpoints written before this rename
+need their ResNet param paths remapped on restore.
 """
 
 from __future__ import annotations

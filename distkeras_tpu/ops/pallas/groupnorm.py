@@ -50,13 +50,13 @@ def _num_chunks(N: int, C: int, budget_bytes: float = 3e5) -> int:
     VMEM->VREG, costing no HBM traffic). Chunk starts stay sublane-aligned
     (CK % 8 == 0) so dynamic slices lower cleanly."""
     best = 1
-    for cand in (32, 16, 8, 4, 2):
+    for cand in (2, 4, 8, 16, 32):  # least-split first: fewest loop trips
         ck = N // cand
         if N % cand == 0 and ck % 8 == 0:
-            best = max(best, cand)
+            best = cand
             if ck * C * 4 <= budget_bytes:
                 return cand
-    return best  # largest aligned split even if over budget
+    return best  # most-split aligned candidate even if over budget
 
 
 def _expand(v, M):

@@ -55,6 +55,39 @@ def test_flash_backward_matches_dense():
                                    err_msg=f"d{name} mismatch")
 
 
+def test_asymmetric_blocks_match_dense():
+    """block_k > block_q (the TPU-tuned shape) and the multi-chunk loop
+    phases (full/masked) must be value-identical to dense."""
+    rng = np.random.default_rng(3)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 128, 1, 16)), jnp.float32)
+               for _ in range(3))
+    ref = dense_causal(q, k, v)
+    for bq, bk in [(16, 64), (16, 128), (32, 64)]:
+        out = flash_attention(q, k, v, block_size=bq, block_k=bk,
+                              interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=5e-2, err_msg=f"bq={bq} bk={bk}")
+    # grads through the asymmetric path too
+    gf = jax.grad(lambda q: jnp.sum(flash_attention(
+        q, k, v, block_size=16, block_k=64, interpret=True) ** 2))(q)
+    gd = jax.grad(lambda q: jnp.sum(dense_causal(q, k, v) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                               atol=0.35, rtol=0.02)
+
+
+def test_default_block_k_covers_all_blockable_lengths():
+    """Every L the q-block accepts must get a valid default k-chunk —
+    L=1280-style lengths (multiple of 128, not of 1024) must not regress."""
+    rng = np.random.default_rng(4)
+    for L in (80, 96, 160):  # multiples of 16, not all of 8*16
+        q, k, v = (jnp.asarray(rng.normal(size=(1, L, 1, 16)), jnp.float32)
+                   for _ in range(3))
+        out = flash_attention(q, k, v, block_size=16, interpret=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(dense_causal(q, k, v)),
+                                   atol=5e-2, err_msg=f"L={L}")
+
+
 def test_transformer_flash_impl_matches_dense():
     tokens = jnp.asarray(np.random.default_rng(2).integers(0, 64, size=(2, 32)),
                          jnp.int32)
