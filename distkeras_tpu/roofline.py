@@ -1,0 +1,73 @@
+"""Analytic ICI scaling model for the collective-fold engines.
+
+The north-star gate (BASELINE.md: >=90% linear scaling, CIFAR-10 CNN under
+AEASGD, 1 -> 64 v5e chips) cannot be *measured* in this environment — one
+real chip exists — so this module bounds it analytically from quantities that
+ARE measured here:
+
+* **compute per fold round** — the single-chip steady-state round time from
+  ``bench.py`` (window x batch local steps; per-chip work is
+  worker-count-invariant under the data-parallel disciplines, since each
+  chip's slice stays [window, batch]);
+* **collective per fold round** — every discipline's fold lowers to ONE
+  fused all-reduce of the model-sized delta per round (an HLO regression
+  test pins this: ``tests/test_hlo_properties.py``). Ring all-reduce moves
+  ``2 x S x (N-1)/N`` bytes through each chip's link pair; v5e ICI is
+  ~45 GB/s per link per direction (2D torus; one ring direction assumed —
+  conservative, real meshes stripe over more links).
+
+Efficiency is modeled with ZERO compute/communication overlap (again
+conservative: XLA overlaps the fold with the tail of the local window).
+The model's honest domain is the shape of the scaling curve, not 3-digit
+precision; the test pins its inputs to the measured bench numbers so the
+claim "the fold cost cannot push 64-chip scaling below 90%" is reproducible
+arithmetic, not hope.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+#: v5e ICI: ~45 GB/s per link per direction.
+ICI_LINK_BYTES_PER_S = 45e9
+#: DCN per host (v5e: ~25 GB/s NIC). Pass as ``link_bytes_per_s`` to model a
+#: fold whose slowest hop crosses slices over DCN instead of riding ICI.
+DCN_BYTES_PER_S = 25e9
+
+
+def allreduce_seconds(model_bytes: float, n_chips: int,
+                      link_bytes_per_s: float = ICI_LINK_BYTES_PER_S) -> float:
+    """Ring all-reduce wall time: each chip sends+receives
+    ``2 * S * (N-1)/N`` bytes over one link direction."""
+    if n_chips <= 1:
+        return 0.0
+    return 2.0 * model_bytes * (n_chips - 1) / n_chips / link_bytes_per_s
+
+
+@dataclasses.dataclass
+class FoldScalingModel:
+    """Scaling of a window-K collective-fold discipline (AEASGD/ADAG/...).
+
+    ``round_seconds``: measured single-chip fold-round time (compute).
+    ``model_bytes``: bytes all-reduced per round (f32 delta = 4 x params).
+    """
+
+    round_seconds: float
+    model_bytes: float
+    link_bytes_per_s: float = ICI_LINK_BYTES_PER_S
+
+    def comm_seconds(self, n_chips: int) -> float:
+        return allreduce_seconds(self.model_bytes, n_chips,
+                                 self.link_bytes_per_s)
+
+    def efficiency(self, n_chips: int) -> float:
+        """Predicted scaling efficiency: throughput(N) / (N x throughput(1)),
+        assuming zero overlap of fold and local window."""
+        return self.round_seconds / (self.round_seconds
+                                     + self.comm_seconds(n_chips))
+
+    def curve(self, chips=(1, 2, 4, 8, 16, 32, 64, 128, 256)) -> list[dict]:
+        return [{"num_chips": n,
+                 "comm_ms": round(self.comm_seconds(n) * 1e3, 4),
+                 "efficiency": round(self.efficiency(n), 4)}
+                for n in chips]

@@ -1,0 +1,97 @@
+"""North-star scaling gate, bounded analytically (VERDICT r2 missing #3).
+
+Real multi-chip runs can't happen here (one chip), so the >=90%@64-chips
+gate is bounded by arithmetic whose inputs are MEASURED: the single-chip
+fold-round time from the committed bench records and the actual model's
+parameter bytes. The model (distkeras_tpu/roofline.py) is conservative —
+one ICI ring direction, zero compute/comm overlap.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+
+from distkeras_tpu.roofline import FoldScalingModel, allreduce_seconds
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The bench AEASGD config (BASELINE #3): window 8, per-chip batch 1024.
+_WINDOW, _BATCH = 8, 1024
+
+
+def _measured_sps_per_chip() -> float:
+    """samples/s/chip for cifar10_cnn_aeasgd from the latest committed bench
+    record (falls back to the round-2 measurement if none is found)."""
+    paths = sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json")))
+    for p in reversed(paths):
+        try:
+            with open(p) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        # Driver-written records wrap the bench line under "parsed".
+        rec = rec.get("parsed", rec)
+        for c in rec.get("configs", []):
+            if c.get("metric", "").startswith("cifar10_cnn_aeasgd") and c.get("value"):
+                return float(c["value"])
+    return 222_000.0  # round-2 floor (BENCH_r02.json)
+
+
+def _model_bytes() -> float:
+    from distkeras_tpu.models.cnn import cifar10_cnn
+
+    m = cifar10_cnn()
+    return m.num_params * 4  # f32 delta per round
+
+
+def test_allreduce_seconds_shape():
+    assert allreduce_seconds(1e6, 1) == 0.0
+    # 2S(N-1)/N monotonically approaches 2S/link as N grows.
+    t64 = allreduce_seconds(1e8, 64)
+    t256 = allreduce_seconds(1e8, 256)
+    assert t64 < t256 < 2 * 1e8 / 45e9
+
+
+def test_north_star_efficiency_bound():
+    """Predicted AEASGD scaling efficiency at 64 v5e chips >= 90%, with the
+    model's inputs pinned from measured single-chip numbers."""
+    sps = _measured_sps_per_chip()
+    round_s = (_WINDOW * _BATCH) / sps  # one fold round of local compute
+    model = FoldScalingModel(round_seconds=round_s, model_bytes=_model_bytes())
+    eff64 = model.efficiency(64)
+    assert eff64 >= 0.90, (
+        f"predicted 64-chip efficiency {eff64:.3f} < 0.90 "
+        f"(round {round_s*1e3:.1f} ms, comm {model.comm_seconds(64)*1e3:.2f} ms)")
+    # And the gate holds with >5x margin on the comm estimate: even a 5x
+    # slower effective link (stragglers, torus contention) stays above 90%.
+    slow = FoldScalingModel(round_seconds=round_s,
+                            model_bytes=_model_bytes(),
+                            link_bytes_per_s=45e9 / 5)
+    assert slow.efficiency(64) >= 0.90
+
+
+def test_curve_is_monotone_and_bounded():
+    m = FoldScalingModel(round_seconds=0.03, model_bytes=6e6)
+    effs = [p["efficiency"] for p in m.curve()]
+    assert all(0 < e <= 1 for e in effs)
+    assert all(a >= b for a, b in zip(effs, effs[1:]))  # monotone down in N
+
+
+def test_small_model_window_tradeoff():
+    """The knob the reference exposed (communication_window) maps directly:
+    doubling the window halves the fold's share, raising efficiency."""
+    base = FoldScalingModel(round_seconds=0.01, model_bytes=1e8)
+    wider = FoldScalingModel(round_seconds=0.02, model_bytes=1e8)
+    assert wider.efficiency(64) > base.efficiency(64)
+
+
+def test_dcn_hop_is_strictly_worse():
+    """A fold whose slowest hop crosses DCN models as a slower link."""
+    from distkeras_tpu.roofline import DCN_BYTES_PER_S
+
+    ici = FoldScalingModel(round_seconds=0.02, model_bytes=1e8)
+    dcn = FoldScalingModel(round_seconds=0.02, model_bytes=1e8,
+                           link_bytes_per_s=DCN_BYTES_PER_S)
+    assert dcn.efficiency(64) < ici.efficiency(64)
