@@ -370,11 +370,14 @@ def scaling_sweep():
         w *= 2
     if ws[-1] != n:
         ws.append(n)  # always measure the full visible device count
+    # One config for both the sweep and the analytic basis below — they must
+    # agree or round_seconds would be computed for the wrong sample count.
+    window, batch = 8, 1024 if on_tpu else 16
     points = []
     base_per_chip = None
     for w in ws:
         rec = _measure("cifar10_cnn_aeasgd", cifar10_cnn, "aeasgd",
-                       batch_size=1024 if on_tpu else 16, window=8,
+                       batch_size=batch, window=window,
                        sample_shape=(32, 32, 3), num_classes=10,
                        timed=8 if on_tpu else 2,
                        rounds_per_program=2 if on_tpu else 1, num_workers=w)
@@ -404,7 +407,6 @@ def scaling_sweep():
         from distkeras_tpu.roofline import FoldScalingModel
 
         sps1 = base_per_chip
-        window, batch = 8, 1024
         model_bytes = cifar10_cnn().num_params * 4
         analytic = FoldScalingModel(
             round_seconds=(window * batch) / sps1, model_bytes=model_bytes)

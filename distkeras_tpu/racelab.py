@@ -1,0 +1,123 @@
+"""A genuinely-raced parameter server, for validating the async mapping.
+
+The framework maps the reference's asynchronous disciplines onto deterministic
+window-K collective folds (``parallel/disciplines.py``). That mapping's claim —
+"same aggregate semantics as the raced socket server" (SURVEY.md §7 hard part
+(a): ADAG-equivalent accuracy) — deserves evidence, not assertion. This module
+re-creates the reference's actual architecture on host threads:
+
+* a **parameter-server object guarding the center variable with a plain lock**
+  (the reference's ``SocketParameterServer.handle_commit`` — SURVEY.md §3.4:
+  one handler thread per worker, ``with lock: fold(delta)``);
+* **N worker threads** that each loop ``pull -> K local steps -> commit``
+  with NO barriers — commits land in whatever order the OS schedules, and
+  staleness is real (DynSGD's counter semantics: server update-counter minus
+  the worker's pull-time counter), not simulated.
+
+Gradient compute is jitted JAX on CPU (releases the GIL, so threads truly
+interleave); the server folds in numpy under the lock, exactly the
+reference's data path minus the socket serialization.
+
+``tests/test_raced_ps.py`` trains the same model on the same data through
+this raced server AND through the deterministic engines, across seeds, and
+asserts final-accuracy parity — closing the async-mapping argument with a
+measurement.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Sequence
+
+import numpy as np
+
+
+class RacedParameterServer:
+    """The reference's server half: lock + fold, commit-order = thread race.
+
+    ``discipline``: 'downpour' (center += delta), 'adag' (center += delta/K,
+    the worker pre-normalizes), or 'dynsgd' (center += delta/(staleness+1)).
+    """
+
+    def __init__(self, center: Sequence[np.ndarray], discipline: str = "adag"):
+        if discipline not in ("downpour", "adag", "dynsgd"):
+            raise ValueError(f"unsupported raced discipline {discipline!r}")
+        self._lock = threading.Lock()
+        self._center = [np.array(a, np.float32) for a in center]
+        self._updates = 0  # server update counter (DynSGD staleness basis)
+        self.discipline = discipline
+        self.commit_log: list[int] = []  # staleness of each commit, in order
+
+    def pull(self) -> tuple[list[np.ndarray], int]:
+        with self._lock:
+            return [a.copy() for a in self._center], self._updates
+
+    def commit(self, delta: Sequence[np.ndarray], pulled_counter: int) -> None:
+        with self._lock:
+            scale = 1.0
+            if self.discipline == "dynsgd":
+                staleness = self._updates - pulled_counter
+                scale = 1.0 / (staleness + 1.0)
+                self.commit_log.append(staleness)
+            for c, d in zip(self._center, delta):
+                c += scale * np.asarray(d, np.float32)
+            self._updates += 1
+
+    def center(self) -> list[np.ndarray]:
+        with self._lock:
+            return [a.copy() for a in self._center]
+
+
+def run_raced(
+    *,
+    center: Sequence[np.ndarray],
+    local_steps: Callable,
+    worker_batches: Sequence[Sequence],
+    window: int,
+    discipline: str = "adag",
+    overlap_first_round: bool = False,
+) -> tuple[list[np.ndarray], RacedParameterServer]:
+    """Race ``len(worker_batches)`` threads against one server.
+
+    ``local_steps(params_list, batch) -> params_list`` runs the K-step local
+    window (jitted JAX; must be thread-safe, which jitted functions are).
+    ``worker_batches[w]`` is worker w's sequence of per-round batches — its
+    Spark-partition analogue; one commit per batch.
+
+    ``overlap_first_round`` holds every worker at a barrier after its first
+    pull, guaranteeing the first W commits race (staleness 0..W-1 realized
+    deterministically) even on hosts whose scheduler would otherwise
+    serialize the threads. Later rounds race freely either way.
+
+    Returns the final center and the server (whose ``commit_log`` shows the
+    realized staleness distribution for dynsgd).
+    """
+    ps = RacedParameterServer(center, discipline)
+    errors: list[BaseException] = []
+    gate = (threading.Barrier(len(worker_batches))
+            if overlap_first_round else None)
+
+    def work(w: int) -> None:
+        try:
+            for r, batch in enumerate(worker_batches[w]):
+                pulled, counter = ps.pull()
+                if gate is not None and r == 0:
+                    gate.wait()
+                new = local_steps(pulled, batch)
+                delta = [np.asarray(n, np.float32) - p
+                         for n, p in zip(new, pulled)]
+                if discipline == "adag":
+                    delta = [d / float(window) for d in delta]
+                ps.commit(delta, counter)
+        except BaseException as e:  # noqa: BLE001 - surface on the main thread
+            errors.append(e)
+
+    threads = [threading.Thread(target=work, args=(w,))
+               for w in range(len(worker_batches))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return ps.center(), ps

@@ -7,8 +7,6 @@ parameter bytes. The model (distkeras_tpu/roofline.py) is conservative —
 one ICI ring direction, zero compute/comm overlap.
 """
 
-import glob
-import json
 import os
 
 import numpy as np
@@ -23,19 +21,16 @@ _WINDOW, _BATCH = 8, 1024
 
 def _measured_sps_per_chip() -> float:
     """samples/s/chip for cifar10_cnn_aeasgd from the latest committed bench
-    record (falls back to the round-2 measurement if none is found)."""
-    paths = sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json")))
-    for p in reversed(paths):
-        try:
-            with open(p) as f:
-                rec = json.load(f)
-        except (OSError, ValueError):
-            continue
-        # Driver-written records wrap the bench line under "parsed".
-        rec = rec.get("parsed", rec)
-        for c in rec.get("configs", []):
-            if c.get("metric", "").startswith("cifar10_cnn_aeasgd") and c.get("value"):
-                return float(c["value"])
+    record, via bench.py's own record reader (one parser, numeric round
+    sort); falls back to the round-2 measurement if no record parses."""
+    import sys
+
+    sys.path.insert(0, _REPO)
+    from bench import _prior_values
+
+    for metric, value in _prior_values().items():
+        if metric.startswith("cifar10_cnn_aeasgd") and value:
+            return float(value)
     return 222_000.0  # round-2 floor (BENCH_r02.json)
 
 
