@@ -54,7 +54,16 @@ def _tree_scale(tree, s):
 
 
 class Discipline:
-    """Base fold rule. Subclasses run *inside* shard_map over ``axis_name``."""
+    """Base fold rule. Subclasses run *inside* shard_map over ``axis_name``.
+
+    The per-worker half is :meth:`commit` (what the reference's Worker sent
+    over the socket, plus the worker's own post-commit update); the server
+    half is the generic :meth:`fold`: ``center += psum(commit)``. Keeping
+    commit separate is what lets the engine **multiplex** several logical
+    workers onto one chip (vmap over the per-chip worker stack, sum their
+    commits locally, one psum across chips) — the reference ran 8 Spark
+    workers on a laptop, so ``num_workers`` must not be capped by chips.
+    """
 
     #: pull-based disciplines start every round from the center variable; elastic
     #: ones keep a persistent local replica.
@@ -68,13 +77,37 @@ class Discipline:
     #: communicating fold). The no-comm ensemble fold trains only locals_, so
     #: pull-the-center elastic resume would discard all learning.
     center_is_trained: bool = True
+    #: whether the fold communicates at all (EnsembleFold does not).
+    communicates: bool = True
 
     def init_state(self, params) -> Any:
         return ()
 
+    def commit(self, center, local, fold_state, *, worker_id, window,
+               num_workers):
+        """(commit_tree, new_local) for ONE worker. ``worker_id`` is the
+        global logical worker index (traced)."""
+        raise NotImplementedError
+
+    def advance(self, fold_state):
+        """Fold-state transition, once per round (not per worker)."""
+        return fold_state
+
     def fold(self, center, local, fold_state, *, axis_name: str, window: int,
              num_workers: int) -> FoldResult:
-        raise NotImplementedError
+        """Single-worker-per-chip fold: commit + one psum. The multi-worker
+        (multiplexed) path lives in the engine, which vmaps :meth:`commit`
+        and sums commits before the same psum."""
+        if not self.communicates:
+            return FoldResult(center, local, self.advance(fold_state))
+        commit, new_local = self.commit(
+            center, local, fold_state,
+            worker_id=lax.axis_index(axis_name), window=window,
+            num_workers=num_workers)
+        new_center = _tree_add(center, lax.psum(commit, axis_name))
+        if self.pulls_center:
+            new_local = new_center
+        return FoldResult(new_center, new_local, self.advance(fold_state))
 
 
 class DownpourFold(Discipline):
@@ -85,11 +118,8 @@ class DownpourFold(Discipline):
     effect of all async commits in one round.
     """
 
-    def fold(self, center, local, fold_state, *, axis_name, window, num_workers):
-        delta = _tree_sub(local, center)
-        total = lax.psum(delta, axis_name)
-        new_center = _tree_add(center, total)
-        return FoldResult(new_center, new_center, fold_state)
+    def commit(self, center, local, fold_state, *, worker_id, window, num_workers):
+        return _tree_sub(local, center), local
 
 
 class ADAGFold(Discipline):
@@ -101,11 +131,8 @@ class ADAGFold(Discipline):
     keeps the center stable as workers (and therefore commit rate) scale.
     """
 
-    def fold(self, center, local, fold_state, *, axis_name, window, num_workers):
-        delta = _tree_scale(_tree_sub(local, center), 1.0 / float(window))
-        total = lax.psum(delta, axis_name)
-        new_center = _tree_add(center, total)
-        return FoldResult(new_center, new_center, fold_state)
+    def commit(self, center, local, fold_state, *, worker_id, window, num_workers):
+        return _tree_scale(_tree_sub(local, center), 1.0 / float(window)), local
 
 
 class DynSGDFold(Discipline):
@@ -128,14 +155,13 @@ class DynSGDFold(Discipline):
     def init_state(self, params):
         return jnp.zeros((), jnp.int32)
 
-    def fold(self, center, local, fold_state, *, axis_name, window, num_workers):
-        worker = lax.axis_index(axis_name)
-        staleness = ((worker + fold_state) % num_workers).astype(jnp.float32)
+    def commit(self, center, local, fold_state, *, worker_id, window, num_workers):
+        staleness = ((worker_id + fold_state) % num_workers).astype(jnp.float32)
         scale = 1.0 / (staleness + 1.0)
-        delta = _tree_scale(_tree_sub(local, center), scale)
-        total = lax.psum(delta, axis_name)
-        new_center = _tree_add(center, total)
-        return FoldResult(new_center, new_center, fold_state + 1)
+        return _tree_scale(_tree_sub(local, center), scale), local
+
+    def advance(self, fold_state):
+        return fold_state + 1
 
 
 class AEASGDFold(Discipline):
@@ -159,11 +185,9 @@ class AEASGDFold(Discipline):
             )
         self.alpha = alpha
 
-    def fold(self, center, local, fold_state, *, axis_name, window, num_workers):
+    def commit(self, center, local, fold_state, *, worker_id, window, num_workers):
         elastic = _tree_scale(_tree_sub(local, center), self.alpha)
-        new_local = _tree_sub(local, elastic)
-        new_center = _tree_add(center, lax.psum(elastic, axis_name))
-        return FoldResult(new_center, new_local, fold_state)
+        return elastic, _tree_sub(local, elastic)
 
 
 class EAMSGDFold(AEASGDFold):
@@ -179,9 +203,9 @@ class EnsembleFold(Discipline):
     pulls_center = False
     syncs_state = False
     center_is_trained = False
-
-    def fold(self, center, local, fold_state, *, axis_name, window, num_workers):
-        return FoldResult(center, local, fold_state)
+    communicates = False
+    # no commit(): communicates=False short-circuits both the engine's
+    # vmapped path and the base fold() before any commit is requested.
 
 
 _DISCIPLINES = {

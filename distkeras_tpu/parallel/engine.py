@@ -59,7 +59,15 @@ def _stack_for_workers(tree, num_workers: int):
 
 
 class AsyncEngine:
-    """Runs a :class:`Discipline` over a 1-D ``data`` mesh."""
+    """Runs a :class:`Discipline` over a 1-D ``data`` mesh.
+
+    ``workers_per_chip`` (m) multiplexes m logical workers onto each chip —
+    the reference ran ``num_workers=8`` Spark executors on a laptop, so the
+    worker count must not be capped by physical chips. The worker axis stays
+    worker-major ([W] = chips x m); per-chip the m replicas run under one
+    vmap, their commits sum locally, and the cross-chip fold is the same
+    single psum — for m=1 this is exactly the one-worker-per-chip program.
+    """
 
     def __init__(
         self,
@@ -74,12 +82,16 @@ class AsyncEngine:
         seed: int = 0,
         per_worker_init: bool = False,
         grad_accum: int = 1,
+        workers_per_chip: int = 1,
     ):
         self.model = model
         self.mesh = mesh
         self.discipline = discipline
         self.window = window
-        self.num_workers = mesh.shape[DATA_AXIS]
+        self.workers_per_chip = int(workers_per_chip)
+        if self.workers_per_chip < 1:
+            raise ValueError(f"workers_per_chip must be >= 1, got {workers_per_chip}")
+        self.num_workers = mesh.shape[DATA_AXIS] * self.workers_per_chip
         self.seed = seed
         self.per_worker_init = per_worker_init
         self.tx = get_optimizer(optimizer, learning_rate)
@@ -96,41 +108,58 @@ class AsyncEngine:
         disc = self.discipline
         window = self.window
         num_workers = self.num_workers
+        m = self.workers_per_chip
         local_loop = self._local_loop
 
         def body(center, locals_, opt_state, fold_state, rng, model_state, xs, ys):
-            # Inside shard_map: leading worker axis is 1 on this slice.
-            local = jax.tree.map(lambda a: jnp.squeeze(a, 0), locals_)
-            opt = jax.tree.map(lambda a: jnp.squeeze(a, 0), opt_state)
-            mstate = jax.tree.map(lambda a: jnp.squeeze(a, 0), model_state)
-            xs0, ys0 = xs[0], ys[0]  # [K, B, ...]
+            # Inside shard_map: this slice carries m logical workers.
+            wids = jax.lax.axis_index(DATA_AXIS) * m + jnp.arange(m)
 
-            start = center if disc.pulls_center else local
-            worker_rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
-            new_local, new_opt, mstate, losses = local_loop(
-                start, opt, xs0, ys0, worker_rng, mstate)
+            start = (jax.tree.map(
+                lambda a: jnp.broadcast_to(a, (m,) + a.shape), center)
+                if disc.pulls_center else locals_)
+            worker_rngs = jax.vmap(lambda w: jax.random.fold_in(rng, w))(wids)
+            new_local, new_opt, mstate, losses = jax.vmap(local_loop)(
+                start, opt_state, xs, ys, worker_rngs, model_state)
             if disc.syncs_state:
                 # Stats fold: cross-worker mean (running statistics average;
                 # they are not gradient-like deltas). Ensemble members keep
                 # their own stats — each must match its own params.
+                mstate = jax.tree.map(
+                    lambda a: jnp.broadcast_to(
+                        a.mean(axis=0, keepdims=True), a.shape), mstate)
                 mstate = lax.pmean(mstate, DATA_AXIS)
-            model_state = jax.tree.map(lambda a: a[None], mstate)
+            model_state = mstate
 
-            new_center, new_local, new_fold_state = disc.fold(
-                center, new_local, fold_state,
-                axis_name=DATA_AXIS, window=window, num_workers=num_workers,
-            )
-            # Per-worker window-mean loss, all-gathered so the [W] history
+            if disc.communicates:
+                commits, new_local = jax.vmap(
+                    lambda loc, w: disc.commit(
+                        center, loc, fold_state, worker_id=w, window=window,
+                        num_workers=num_workers))(new_local, wids)
+                total = lax.psum(
+                    jax.tree.map(lambda a: a.sum(axis=0), commits), DATA_AXIS)
+                new_center = jax.tree.map(jnp.add, center, total)
+                if disc.pulls_center:
+                    new_local = jax.tree.map(
+                        lambda a: jnp.broadcast_to(a, (m,) + a.shape),
+                        new_center)
+            else:
+                new_center = center
+            new_fold_state = disc.advance(fold_state)
+            # Per-worker window-mean losses, all-gathered so the [W] history
             # vector is REPLICATED (fully addressable on every process of a
             # multi-host mesh — a data-sharded loss can't be fetched on the
             # driver). These are the per-worker training histories the
             # reference optionally collected (SURVEY.md §5 metrics row).
-            loss = lax.all_gather(jnp.mean(losses), DATA_AXIS)
+            # all_gather gives [chips, m]; worker-major reshape -> [W].
+            loss = lax.all_gather(
+                jnp.mean(losses, axis=tuple(range(1, losses.ndim))),
+                DATA_AXIS).reshape(-1)
             next_rng = jax.random.split(rng, 1)[0]
             return (
                 new_center,
-                jax.tree.map(lambda a: a[None], new_local),
-                jax.tree.map(lambda a: a[None], new_opt),
+                new_local,
+                new_opt,
                 new_fold_state,
                 next_rng,
                 model_state,
@@ -287,14 +316,19 @@ class AsyncEngine:
                           rounds_per_program)
 
 
-def local_worker_ids(mesh) -> list[int]:
-    """Global worker ids whose chips THIS process hosts (1-D data mesh).
+def local_worker_ids(mesh, workers_per_chip: int = 1) -> list[int]:
+    """Global LOGICAL worker ids whose chips THIS process hosts (1-D data
+    mesh). With multiplexing, chip c carries workers [c*m, (c+1)*m).
 
     The sharded data plane's unit of locality: a process stages rows for
     exactly these workers (``stage_round``), so per-host disk shards follow
     the device→process mapping with no extra bookkeeping."""
     pi = jax.process_index()
-    return [w for w, d in enumerate(mesh.devices.flat) if d.process_index == pi]
+    m = workers_per_chip
+    return [c * m + j
+            for c, d in enumerate(mesh.devices.flat)
+            if d.process_index == pi
+            for j in range(m)]
 
 
 def put_worker_local(local, mesh, num_workers: int, local_workers: list[int],
@@ -334,7 +368,8 @@ def stage_round(engine, plan, r: int):
     Single-process, the full ``round`` gather IS the local gather (every
     shard is addressable), so the plain path serves both."""
     if getattr(plan, "is_local", False) and jax.process_count() > 1:
-        lw = local_worker_ids(engine.mesh)
+        lw = local_worker_ids(engine.mesh,
+                              getattr(engine, "workers_per_chip", 1))
         xs, ys = plan.round_local(r, lw)
         put = lambda a: put_worker_local(
             a, engine.mesh, plan.num_workers, lw, 0, P(DATA_AXIS))
@@ -352,7 +387,8 @@ def stage_block(engine, plan, rs) -> tuple:
         return engine._put_block(np.stack([b[0] for b in batches]),
                                  np.stack([b[1] for b in batches]))
     if getattr(plan, "is_local", False) and jax.process_count() > 1:
-        lw = local_worker_ids(engine.mesh)
+        lw = local_worker_ids(engine.mesh,
+                              getattr(engine, "workers_per_chip", 1))
         batches = [plan.round_local(r, lw) for r in rs]
         xs = np.stack([b[0] for b in batches])
         ys = np.stack([b[1] for b in batches])

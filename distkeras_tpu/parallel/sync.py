@@ -49,10 +49,29 @@ class SyncEngine:
         compute_dtype=None,
         seed: int = 0,
         grad_accum: int = 1,
+        workers_per_chip: int = 1,
     ):
         self.model = model
         self.mesh = mesh
-        self.num_workers = mesh.shape[DATA_AXIS]
+        #: m logical workers per chip (reference parity: num_workers is a
+        #: Spark-executor count, not a chip count). The multiplex folds the m
+        #: workers into the per-chip batch ([m*B] per step) — gradient-exact
+        #: for deterministic stateless models (mean over m*B == mean of m
+        #: B-means), but dropout streams and BatchNorm batch statistics see
+        #: the merged batch, not m per-worker batches.
+        self.workers_per_chip = int(workers_per_chip)
+        if self.workers_per_chip < 1:
+            raise ValueError(f"workers_per_chip must be >= 1, got {workers_per_chip}")
+        if self.workers_per_chip > 1 and model.state_collections:
+            import warnings
+
+            warnings.warn(
+                "SyncEngine with workers_per_chip > 1 computes batch "
+                "statistics (BatchNorm) over the merged m*B per-chip batch, "
+                "not per logical worker — a slightly different trajectory "
+                "than the same num_workers spread across chips",
+                stacklevel=2)
+        self.num_workers = mesh.shape[DATA_AXIS] * self.workers_per_chip
         self.seed = seed
         self.tx = get_optimizer(optimizer, learning_rate)
         self.loss_fn = get_loss(loss)
@@ -73,10 +92,19 @@ class SyncEngine:
             grad_accum=self.grad_accum,
         )
 
+        m = self.workers_per_chip
+
         def body(params, opt_state, rng, model_state, xs, ys):
-            # xs: [1, K, B/W, ...] on this slice — same worker-major layout as the
-            # async engine, so one BatchPlan serves both engines.
-            xs0, ys0 = xs[0], ys[0]
+            # xs: [m, K, B, ...] on this slice — same worker-major layout as
+            # the async engine, so one BatchPlan serves both engines. The m
+            # multiplexed workers fold into the batch axis: [K, m*B, ...]
+            # (gradient mean over m*B == mean of m workers' B-means).
+            def merge(a):
+                moved = jnp.swapaxes(a, 0, 1)  # [K, m, B, ...]
+                return moved.reshape((moved.shape[0], m * moved.shape[2])
+                                     + moved.shape[3:])
+
+            xs0, ys0 = merge(xs), merge(ys)
             # Per-replica dropout stream; the *carried* rng stays replicated (the
             # divergent key never leaves the local loop).
             step_rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))

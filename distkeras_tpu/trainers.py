@@ -416,7 +416,20 @@ class DistributedTrainer(Trainer):
         self.config = self.config.replace(num_workers=num_workers)
 
     def _mesh(self):
-        return data_mesh(num_workers=self.num_workers)
+        """(mesh, workers_per_chip): ``num_workers`` is a *logical* worker
+        count (the reference's Spark-executor count — 8 workers on a laptop
+        was normal), so counts beyond the chip count multiplex m workers
+        onto each chip instead of erroring."""
+        w = self.num_workers
+        devices = jax.device_count()
+        if w is None or w <= devices:
+            return data_mesh(num_workers=w), 1
+        if w % devices == 0:
+            return data_mesh(), w // devices
+        raise ValueError(
+            f"num_workers={w} exceeds the {devices} available chips and "
+            f"does not divide evenly onto them; use a multiple of {devices} "
+            "(m workers per chip) or at most the chip count")
 
 
 class SynchronousDistributedTrainer(DistributedTrainer):
@@ -429,11 +442,11 @@ class SynchronousDistributedTrainer(DistributedTrainer):
 
     def train(self, dataframe: DataFrame, shuffle: bool = False) -> Model:
         self.record_training_start()
-        mesh = self._mesh()
+        mesh, m = self._mesh()
         engine = SyncEngine(
             self.model, self.worker_optimizer, self.loss, mesh,
             learning_rate=self.learning_rate, compute_dtype=self.compute_dtype,
-            seed=self.seed, grad_accum=self.grad_accum,
+            seed=self.seed, grad_accum=self.grad_accum, workers_per_chip=m,
         )
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,
@@ -459,12 +472,12 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         raise NotImplementedError
 
     def _run(self, dataframe: DataFrame, shuffle: bool):
-        mesh = self._mesh()
+        mesh, m = self._mesh()
         engine = AsyncEngine(
             self.model, self.worker_optimizer, self.loss, self._discipline(), mesh,
             window=self.communication_window, learning_rate=self.learning_rate,
             compute_dtype=self.compute_dtype, seed=self.seed,
-            grad_accum=self.grad_accum,
+            grad_accum=self.grad_accum, workers_per_chip=m,
         )
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,
@@ -706,7 +719,7 @@ class AveragingTrainer(DistributedTrainer):
 
     def train(self, dataframe: DataFrame, shuffle: bool = False) -> Model:
         self.record_training_start()
-        mesh = self._mesh()
+        mesh, m = self._mesh()
         # NOTE: replicas deliberately share one init (per_worker_init=False).
         # Post-hoc *weight* averaging is only meaningful when all replicas
         # descend within one loss basin; averaging independently-initialized
@@ -716,7 +729,7 @@ class AveragingTrainer(DistributedTrainer):
             self.model, self.worker_optimizer, self.loss, EnsembleFold(), mesh,
             window=self.communication_window, learning_rate=self.learning_rate,
             compute_dtype=self.compute_dtype, seed=self.seed,
-            grad_accum=self.grad_accum,
+            grad_accum=self.grad_accum, workers_per_chip=m,
         )
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,
@@ -742,12 +755,12 @@ class EnsembleTrainer(DistributedTrainer):
 
     def train(self, dataframe: DataFrame, shuffle: bool = False) -> list[Model]:
         self.record_training_start()
-        mesh = self._mesh()
+        mesh, m = self._mesh()
         engine = AsyncEngine(
             self.model, self.worker_optimizer, self.loss, EnsembleFold(), mesh,
             window=self.communication_window, learning_rate=self.learning_rate,
             compute_dtype=self.compute_dtype, seed=self.seed, per_worker_init=True,
-            grad_accum=self.grad_accum,
+            grad_accum=self.grad_accum, workers_per_chip=m,
         )
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,

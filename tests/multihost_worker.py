@@ -67,9 +67,14 @@ def main() -> None:
 
         if os.environ.get("DK_DISJOINT") == "1":
             store = ShardStore.open(shard_dir)
-            local_workers = [w for w, dev in enumerate(jax.devices())
-                             if dev.process_index == jax.process_index()]
-            parts = worker_partition(store.count(), jax.device_count())
+            # Logical workers: chip c carries workers [c*m, (c+1)*m) when
+            # num_workers multiplexes beyond the chip count.
+            W = int(os.environ.get("DK_NUM_WORKERS", jax.device_count()))
+            m = W // jax.device_count()
+            local_workers = [c * m + j for c, dev in enumerate(jax.devices())
+                             if dev.process_index == jax.process_index()
+                             for j in range(m)]
+            parts = worker_partition(store.count(), W)
             needed = set()
             for w in local_workers:
                 needed.update(store.shards_for_rows(*parts[w]))
@@ -104,7 +109,9 @@ def main() -> None:
 
     common = dict(
         loss="sparse_categorical_crossentropy",
-        num_workers=jax.device_count(),  # the full global mesh, both processes
+        # Default: one worker per chip of the global mesh; DK_NUM_WORKERS
+        # overrides (beyond the chip count = multiplexed workers).
+        num_workers=int(os.environ.get("DK_NUM_WORKERS", jax.device_count())),
         batch_size=16, num_epoch=2, learning_rate=0.1,
         checkpoint_dir=os.environ.get("DK_CKPT_DIR") or None,
         checkpoint_every=int(os.environ.get("DK_CKPT_EVERY", "0")),

@@ -229,6 +229,52 @@ def test_two_process_disjoint_shards(tmp_path):
 
 
 @pytest.mark.slow
+def test_disjoint_shards_with_multiplexed_workers(tmp_path):
+    """Sharded data plane x worker multiplexing: 8 logical workers on a
+    4-chip 2-process mesh (m=2), hosts holding only their own workers'
+    shard files. Locality must follow LOGICAL worker ids — chip c owns
+    workers [2c, 2c+2) — or processes would stage other partitions' rows."""
+    import numpy as np
+
+    from distkeras_tpu.data.shards import write_shards
+
+    rng = np.random.default_rng(0)
+    n, d, c = 1024, 4, 3
+    centers = rng.normal(scale=4.0, size=(c, d))
+    y = rng.integers(0, c, size=n)
+    x = (centers[y] + rng.normal(scale=0.5, size=(n, d))).astype(np.float32)
+    store = tmp_path / "store"
+    # 128 rows/shard on 8 logical workers: shard w == worker w's partition.
+    write_shards(store, {"features": x, "label": y.astype(np.int32)},
+                 rows_per_shard=128)
+
+    env = {"DK_SHARD_DIR": str(store), "DK_NUM_WORKERS": "8"}
+    full_dir = tmp_path / "full"
+    full_dir.mkdir()
+    _job, rcs = _launch_job(full_dir, env, timeout=600,
+                            job_name="pytest-mux-full")
+    assert rcs == [0, 0], f"full-store run failed: rcs={rcs}"
+    full = _read_results(full_dir)
+
+    disj_dir = tmp_path / "disj"
+    disj_dir.mkdir()
+    _job, rcs = _launch_job(disj_dir, {**env, "DK_DISJOINT": "1"},
+                            timeout=600, job_name="pytest-mux-disjoint")
+    assert rcs == [0, 0], f"disjoint multiplexed run failed: rcs={rcs}"
+    disj = _read_results(disj_dir)
+
+    # Each process links its 4 logical workers' shards (x2 columns) + manifest.
+    for i in range(2):
+        files = sorted(p.name for p in (disj_dir / f"shards_proc{i}").iterdir())
+        assert len(files) == 9, files
+    assert (disj_dir / "shards_proc1" / "shard-00004.features.npy").exists()
+
+    for r in full + disj:
+        assert r["accuracy"] > 0.85, r
+    assert disj[0]["history"] == pytest.approx(full[0]["history"], rel=1e-6)
+
+
+@pytest.mark.slow
 def test_fault_injection_checkpoint_recovery(tmp_path):
     """Kill one host mid-training (hard abort, no cleanup — a preempted pod
     host), then relaunch the job with resume: the recovered run must finish

@@ -1,0 +1,139 @@
+"""Worker multiplexing: num_workers beyond the chip count (VERDICT r2 weak #7).
+
+The reference's ``num_workers`` was a Spark-executor count — 8 workers on a
+laptop was the normal case — so the TPU rebuild must not cap it at the chip
+count. ``workers_per_chip`` stacks m logical workers per chip on the worker
+axis. The golden property: the SAME logical worker schedule run multiplexed
+(m workers/chip on fewer chips) equals the spread run (one worker/chip) —
+same data, same worker ids, same folds; only the device placement differs.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distkeras_tpu import (ADAG, AEASGD, DataFrame, DynSGD, EnsembleTrainer,
+                           SynchronousDistributedTrainer)
+from distkeras_tpu.data.batching import make_batches
+from distkeras_tpu.models import Model
+from distkeras_tpu.models.mlp import MLP
+from distkeras_tpu.parallel.disciplines import get_discipline
+from distkeras_tpu.parallel.engine import AsyncEngine
+from distkeras_tpu.parallel.sync import SyncEngine
+from distkeras_tpu.runtime.mesh import data_mesh
+
+N, DIM, C = 512, 4, 3
+
+
+def _blobs(seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=4.0, size=(C, DIM))
+    y = rng.integers(0, C, size=N)
+    x = (centers[y] + rng.normal(scale=0.5, size=(N, DIM))).astype(np.float32)
+    return x, y.astype(np.int32)
+
+
+def _model():
+    return Model.build(MLP(hidden=(16,), num_outputs=C),
+                       np.zeros((1, DIM), np.float32), seed=0)
+
+
+def _run_async(discipline, mesh_workers, m, plan_w=4, window=4):
+    x, y = _blobs()
+    df = DataFrame({"features": x, "label": y})
+    plan = make_batches(df, "features", "label", batch_size=8,
+                        num_workers=plan_w, window=window, num_epoch=2)
+    disc = (get_discipline(discipline, alpha=0.05)
+            if discipline == "aeasgd" else get_discipline(discipline))
+    eng = AsyncEngine(_model(), "sgd", "sparse_categorical_crossentropy",
+                      disc, data_mesh(num_workers=mesh_workers),
+                      window=window, learning_rate=0.1, workers_per_chip=m)
+    assert eng.num_workers == plan_w
+    state, losses = eng.run(plan)
+    return state, np.asarray(losses)
+
+
+@pytest.mark.parametrize("discipline", ["adag", "dynsgd", "aeasgd"])
+def test_multiplexed_equals_spread(discipline):
+    """W=4 on 4 chips == W=4 as 2x2 multiplexed on 2 chips, to float assoc
+    tolerance (the psum sums the same per-worker commits either way)."""
+    spread, l_spread = _run_async(discipline, mesh_workers=4, m=1)
+    muxed, l_muxed = _run_async(discipline, mesh_workers=2, m=2)
+    np.testing.assert_allclose(l_muxed, l_spread, rtol=2e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(spread.center),
+                    jax.tree.leaves(muxed.center)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_sync_multiplexed_equals_spread():
+    x, y = _blobs()
+    df = DataFrame({"features": x, "label": y})
+
+    def run(mesh_workers, m):
+        plan = make_batches(df, "features", "label", batch_size=8,
+                            num_workers=4, window=4, num_epoch=2)
+        eng = SyncEngine(_model(), "sgd", "sparse_categorical_crossentropy",
+                         data_mesh(num_workers=mesh_workers),
+                         learning_rate=0.1, workers_per_chip=m)
+        assert eng.num_workers == 4
+        state, losses = eng.run(plan)
+        return state, np.asarray(losses)
+
+    spread, l_spread = run(4, 1)
+    muxed, l_muxed = run(2, 2)
+    np.testing.assert_allclose(l_muxed, l_spread, rtol=2e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(spread.params),
+                    jax.tree.leaves(muxed.params)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_trainer_num_workers_beyond_devices():
+    """The reference-notebook case: ADAG(num_workers=16) on an 8-device mesh
+    trains, converges, and reports 16 per-worker histories."""
+    x, y = _blobs()
+    df = DataFrame({"features": x, "label": y})
+    t = ADAG(_model(), loss="sparse_categorical_crossentropy", num_workers=16,
+             batch_size=4, num_epoch=3, learning_rate=0.1,
+             communication_window=2)
+    trained = t.train(df, shuffle=True)
+    acc = (np.asarray(trained.predict(x)).argmax(-1) == y).mean()
+    assert acc > 0.85, acc
+    assert t.get_worker_histories().shape[0] == 16
+
+
+def test_trainer_indivisible_num_workers_raises():
+    x, y = _blobs()
+    with pytest.raises(ValueError, match="divide evenly"):
+        SynchronousDistributedTrainer(
+            _model(), loss="sparse_categorical_crossentropy", num_workers=13,
+            batch_size=4).train(DataFrame({"features": x, "label": y}))
+
+
+def test_ensemble_multiplexed_members_independent():
+    """EnsembleTrainer with more members than chips: every member trains its
+    own params (per-worker init preserved through the multiplex)."""
+    x, y = _blobs()
+    df = DataFrame({"features": x, "label": y})
+    t = EnsembleTrainer(_model(), loss="sparse_categorical_crossentropy",
+                        num_workers=16, batch_size=4, num_epoch=1,
+                        learning_rate=0.1, communication_window=2)
+    models = t.train(df)
+    assert len(models) == 16
+    p0 = jax.tree.leaves(models[0].params)[0]
+    p9 = jax.tree.leaves(models[9].params)[0]
+    assert not np.allclose(np.asarray(p0), np.asarray(p9))
+
+
+def test_dynsgd_staleness_uses_global_worker_id():
+    """DynSGD's staleness rotation must key on the GLOBAL worker id under
+    multiplexing — the fold-equalization property (every worker sees every
+    staleness over W rounds) holds exactly when ids are global."""
+    spread, _ = _run_async("dynsgd", mesh_workers=4, m=1, window=2)
+    muxed, _ = _run_async("dynsgd", mesh_workers=1, m=4, window=2)
+    for a, b in zip(jax.tree.leaves(spread.center),
+                    jax.tree.leaves(muxed.center)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-5, atol=1e-6)
