@@ -62,16 +62,20 @@ class SyncEngine:
         self.workers_per_chip = int(workers_per_chip)
         if self.workers_per_chip < 1:
             raise ValueError(f"workers_per_chip must be >= 1, got {workers_per_chip}")
-        if self.workers_per_chip > 1 and model.state_collections:
+        if self.workers_per_chip > 1:
             import warnings
 
             warnings.warn(
-                "SyncEngine with workers_per_chip > 1 computes batch "
-                "statistics (BatchNorm) over the merged m*B per-chip batch, "
-                "not per logical worker — a slightly different trajectory "
-                "than the same num_workers spread across chips",
+                "SyncEngine with workers_per_chip > 1 folds the m logical "
+                "workers into one merged m*B per-chip batch: gradient-exact "
+                "for deterministic stateless models, but batch statistics "
+                "(BatchNorm) and stochastic-layer streams (dropout) see the "
+                "merged batch — a slightly different trajectory than the "
+                "same num_workers spread across chips",
                 stacklevel=2)
         self.num_workers = mesh.shape[DATA_AXIS] * self.workers_per_chip
+        #: physical chips (num_workers is logical under multiplexing).
+        self.num_chips = int(mesh.devices.size)
         self.seed = seed
         self.tx = get_optimizer(optimizer, learning_rate)
         self.loss_fn = get_loss(loss)
@@ -98,8 +102,11 @@ class SyncEngine:
             # xs: [m, K, B, ...] on this slice — same worker-major layout as
             # the async engine, so one BatchPlan serves both engines. The m
             # multiplexed workers fold into the batch axis: [K, m*B, ...]
-            # (gradient mean over m*B == mean of m workers' B-means).
+            # (gradient mean over m*B == mean of m workers' B-means). m == 1
+            # keeps the plain slice (identical program to pre-multiplex).
             def merge(a):
+                if m == 1:
+                    return a[0]
                 moved = jnp.swapaxes(a, 0, 1)  # [K, m, B, ...]
                 return moved.reshape((moved.shape[0], m * moved.shape[2])
                                      + moved.shape[3:])

@@ -67,13 +67,21 @@ def main() -> None:
 
         if os.environ.get("DK_DISJOINT") == "1":
             store = ShardStore.open(shard_dir)
-            # Logical workers: chip c carries workers [c*m, (c+1)*m) when
-            # num_workers multiplexes beyond the chip count.
+            # Logical workers per chip, matching the engine's mapping
+            # (parallel/engine.local_worker_ids): W <= chips puts worker w on
+            # chip w (submesh); W beyond the chip count multiplexes m per
+            # chip as [c*m, (c+1)*m).
             W = int(os.environ.get("DK_NUM_WORKERS", jax.device_count()))
-            m = W // jax.device_count()
-            local_workers = [c * m + j for c, dev in enumerate(jax.devices())
-                             if dev.process_index == jax.process_index()
-                             for j in range(m)]
+            pid = jax.process_index()
+            if W <= jax.device_count():
+                local_workers = [w for w, dev in enumerate(jax.devices()[:W])
+                                 if dev.process_index == pid]
+            else:
+                m = W // jax.device_count()
+                local_workers = [c * m + j
+                                 for c, dev in enumerate(jax.devices())
+                                 if dev.process_index == pid
+                                 for j in range(m)]
             parts = worker_partition(store.count(), W)
             needed = set()
             for w in local_workers:
