@@ -248,6 +248,15 @@ class ShardedDataFrame:
     def repartition(self, n: int) -> "ShardedDataFrame":
         return ShardedDataFrame(self.store, num_partitions=n)
 
+    def iter_column_chunks(self, *cols: str):
+        """Yield ``{col: rows}`` one shard at a time — the bounded-memory
+        row stream that out-of-core predictors/evaluators consume (the
+        Spark-partition-iterator analogue)."""
+        for s in range(self.store.num_shards):
+            lo, hi = self.store.shard_range(s)
+            ids = np.arange(lo, hi)
+            yield {c: self.store.gather(c, ids) for c in cols}
+
     def __getattr__(self, name):
         if name in {"with_column", "select", "drop", "take_rows", "shuffle",
                     "split", "random_split", "randomSplit", "iter_rows"}:

@@ -21,13 +21,29 @@ def _to_class_indices(col: np.ndarray) -> np.ndarray:
 
 
 class Evaluator:
-    """Base: ``evaluate(df) -> float``."""
+    """Base: ``evaluate(df) -> float``.
+
+    Works on in-RAM :class:`DataFrame`\\ s AND out-of-core
+    ``ShardedDataFrame``\\ s — sharded stores evaluate as a bounded-memory
+    stream (one shard's rows at a time) via per-chunk accumulation, so an
+    ImageNet-scale prediction store never needs to fit in RAM."""
 
     def __init__(self, prediction_col: str = "prediction", label_col: str = "label"):
         self.prediction_col = prediction_col
         self.label_col = label_col
 
-    def evaluate(self, dataframe: DataFrame) -> float:
+    def _chunks(self, dataframe):
+        """(pred_indices, label_indices) per bounded chunk."""
+        if getattr(dataframe, "is_sharded", False):
+            for chunk in dataframe.iter_column_chunks(
+                    self.prediction_col, self.label_col):
+                yield (_to_class_indices(chunk[self.prediction_col]),
+                       _to_class_indices(chunk[self.label_col]))
+        else:
+            yield (_to_class_indices(dataframe[self.prediction_col]),
+                   _to_class_indices(dataframe[self.label_col]))
+
+    def evaluate(self, dataframe) -> float:
         raise NotImplementedError
 
 
@@ -38,27 +54,36 @@ class AccuracyEvaluator(Evaluator):
     logits, probabilities, one-hot, or integer columns on either side.
     """
 
-    def evaluate(self, dataframe: DataFrame) -> float:
-        pred = _to_class_indices(dataframe[self.prediction_col])
-        label = _to_class_indices(dataframe[self.label_col])
-        return float((pred == label).mean())
+    def evaluate(self, dataframe) -> float:
+        correct = total = 0
+        for pred, label in self._chunks(dataframe):
+            correct += int((pred == label).sum())
+            total += len(label)
+        return correct / total if total else 0.0
 
 
 class F1Evaluator(Evaluator):
     """Macro-averaged F1 (the notebooks' Spark-ML MulticlassClassificationEvaluator
     equivalent)."""
 
-    def evaluate(self, dataframe: DataFrame) -> float:
-        pred = _to_class_indices(dataframe[self.prediction_col])
-        label = _to_class_indices(dataframe[self.label_col])
+    def evaluate(self, dataframe) -> float:
+        from collections import defaultdict
+
+        tp: dict = defaultdict(int)
+        fp: dict = defaultdict(int)
+        fn: dict = defaultdict(int)
+        classes: set = set()
+        for pred, label in self._chunks(dataframe):
+            classes.update(np.unique(label).tolist())
+            for c in set(np.unique(label)) | set(np.unique(pred)):
+                tp[c] += int(np.sum((pred == c) & (label == c)))
+                fp[c] += int(np.sum((pred == c) & (label != c)))
+                fn[c] += int(np.sum((pred != c) & (label == c)))
         scores = []
-        for c in np.unique(label):
-            tp = np.sum((pred == c) & (label == c))
-            fp = np.sum((pred == c) & (label != c))
-            fn = np.sum((pred != c) & (label == c))
-            denom = 2 * tp + fp + fn
-            scores.append(2 * tp / denom if denom else 0.0)
-        return float(np.mean(scores))
+        for c in sorted(classes):  # macro over classes present in labels
+            denom = 2 * tp[c] + fp[c] + fn[c]
+            scores.append(2 * tp[c] / denom if denom else 0.0)
+        return float(np.mean(scores)) if scores else 0.0
 
 
 class LossEvaluator(Evaluator):
@@ -71,9 +96,19 @@ class LossEvaluator(Evaluator):
 
         self.loss_fn = get_loss(loss)
 
-    def evaluate(self, dataframe: DataFrame) -> float:
+    def evaluate(self, dataframe) -> float:
         import jax.numpy as jnp
 
-        pred = jnp.asarray(dataframe[self.prediction_col])
-        label = jnp.asarray(dataframe[self.label_col])
-        return float(self.loss_fn(pred, label))
+        def one(pred, label):
+            return float(self.loss_fn(jnp.asarray(pred), jnp.asarray(label)))
+
+        if getattr(dataframe, "is_sharded", False):
+            total = n = 0.0
+            for chunk in dataframe.iter_column_chunks(
+                    self.prediction_col, self.label_col):
+                k = len(chunk[self.label_col])
+                total += one(chunk[self.prediction_col],
+                             chunk[self.label_col]) * k
+                n += k
+            return total / n if n else 0.0
+        return one(dataframe[self.prediction_col], dataframe[self.label_col])
