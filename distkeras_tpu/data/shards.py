@@ -182,10 +182,14 @@ class ShardStore:
         return list(range(max(s0, 0), max(s1, 0)))
 
     def _map(self, shard: int, col: str) -> np.ndarray:
+        # Columns may be stored under a versioned physical name ("file"):
+        # re-predicting an existing output column writes fresh files and
+        # swaps the manifest atomically instead of renaming over live ones.
+        phys = self.columns.get(col, {}).get("file", col)
         key = (shard, col)
         mm = self._maps.get(key)
         if mm is None:
-            fp = os.path.join(self.path, _shard_file(shard, col))
+            fp = os.path.join(self.path, _shard_file(shard, phys))
             mm = np.load(fp, mmap_mode="r")
             while len(self._maps) >= self._max_open:
                 # Dropping the reference closes the underlying mmap + fd
@@ -199,6 +203,13 @@ class ShardStore:
     def close(self) -> None:
         """Release every cached memmap (and its file descriptor)."""
         self._maps.clear()
+
+    def read_shard(self, shard: int, col: str) -> np.ndarray:
+        """One whole shard of a column (a single sequential read — the fast
+        path for full scans). Returns a writable COPY: handing out the
+        cached memmap would let consumers pin evicted maps' file
+        descriptors past the LRU bound."""
+        return np.array(self._map(shard, col))
 
     def gather(self, col: str, row_ids: np.ndarray) -> np.ndarray:
         """``rows[row_ids]`` across shard files; result shape
@@ -251,11 +262,10 @@ class ShardedDataFrame:
     def iter_column_chunks(self, *cols: str):
         """Yield ``{col: rows}`` one shard at a time — the bounded-memory
         row stream that out-of-core predictors/evaluators consume (the
-        Spark-partition-iterator analogue)."""
+        Spark-partition-iterator analogue). Whole-shard reads go straight to
+        the memmap (one sequential read; no per-row index math)."""
         for s in range(self.store.num_shards):
-            lo, hi = self.store.shard_range(s)
-            ids = np.arange(lo, hi)
-            yield {c: self.store.gather(c, ids) for c in cols}
+            yield {c: self.store.read_shard(s, c) for c in cols}
 
     def __getattr__(self, name):
         if name in {"with_column", "select", "drop", "take_rows", "shuffle",

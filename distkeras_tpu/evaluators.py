@@ -87,13 +87,19 @@ class F1Evaluator(Evaluator):
 
 
 class LossEvaluator(Evaluator):
-    """Mean loss of a prediction column vs labels under a registry loss."""
+    """Mean loss of a prediction column vs labels under a registry loss.
+
+    The sharded-store path accumulates ``chunk_loss * chunk_rows / total`` —
+    exact iff the loss is MEAN-reduced over rows (every registry loss is).
+    A custom sum-reduced callable would evaluate differently on a
+    ShardedDataFrame than in-RAM, so non-registry callables warn once."""
 
     def __init__(self, loss: str = "sparse_categorical_crossentropy",
                  prediction_col: str = "prediction", label_col: str = "label"):
         super().__init__(prediction_col, label_col)
         from distkeras_tpu.ops.losses import get_loss
 
+        self._custom_loss = not isinstance(loss, str)
         self.loss_fn = get_loss(loss)
 
     def evaluate(self, dataframe) -> float:
@@ -103,6 +109,14 @@ class LossEvaluator(Evaluator):
             return float(self.loss_fn(jnp.asarray(pred), jnp.asarray(label)))
 
         if getattr(dataframe, "is_sharded", False):
+            if self._custom_loss:
+                import warnings
+
+                warnings.warn(
+                    "LossEvaluator over a sharded store assumes the loss is "
+                    "mean-reduced per row (chunk losses are row-weighted); "
+                    "a sum-reduced custom callable will not match the "
+                    "in-RAM result", stacklevel=2)
             total = n = 0.0
             for chunk in dataframe.iter_column_chunks(
                     self.prediction_col, self.label_col):

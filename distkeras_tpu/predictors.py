@@ -90,6 +90,74 @@ class ModelPredictor(Predictor):
         x = np.asarray(dataframe[self.features_col])
         return dataframe.with_column(self.output_col, self._predict_array(x))
 
+    def predict_stream(self, source):
+        """Yield ``predictions`` (one array per source microbatch, in order).
+
+        ``source`` yields feature arrays shaped ``[n, ...]`` (n may vary per
+        item; wrap single records as length-1 batches). Rows accumulate into
+        ``chunk_size`` compute chunks across microbatch boundaries; only the
+        final partial chunk is padded. This is both the streaming-inference
+        surface (:class:`StreamingPredictor`) and the engine under the
+        sharded-store path (shards are the microbatches)."""
+        from collections import deque
+
+        sizes: deque[int] = deque()  # rows per emitted-pending microbatch
+        pending: list[np.ndarray] = []  # rows awaiting a forward pass
+        ready: list[np.ndarray] = []  # predicted rows, FIFO
+
+        def pending_rows() -> int:
+            return sum(len(r) for r in pending)
+
+        def compute(flush: bool) -> None:
+            x = np.concatenate(pending, axis=0) if pending else None
+            if x is None or not len(x):
+                return
+            take = (len(x) // self.chunk_size) * self.chunk_size
+            if flush:
+                take = len(x)  # pad out the final partial chunk
+            if take == 0:
+                return
+            ready.append(self._predict_array(x[:take]))
+            pending.clear()
+            if take < len(x):
+                pending.append(x[take:])
+
+        def drain():
+            while sizes:
+                need = sizes[0]
+                if need == 0:
+                    # Empty microbatch (e.g. an empty poll on a stream):
+                    # emit an empty row block with the output tail shape.
+                    sizes.popleft()
+                    yield (ready[0][:0] if ready
+                           else np.empty((0,), np.float32))
+                    continue
+                if sum(len(r) for r in ready) < need:
+                    return
+                parts = []
+                while need:
+                    r = ready[0]
+                    if len(r) <= need:
+                        parts.append(ready.pop(0))
+                        need -= len(parts[-1])
+                    else:
+                        parts.append(r[:need])
+                        ready[0] = r[need:]
+                        need = 0
+                sizes.popleft()
+                yield np.concatenate(parts, axis=0)
+
+        for microbatch in source:
+            mb = np.asarray(microbatch)
+            sizes.append(len(mb))
+            if len(mb):  # an empty poll has no rows (and no feature dims)
+                pending.append(mb)
+            if pending_rows() >= self.chunk_size:
+                compute(flush=False)
+            yield from drain()
+        compute(flush=True)
+        yield from drain()
+
     def _predict_sharded(self, sdf):
         """Out-of-core inference: predictions stream to disk as a NEW column
         of the same store (bounded RAM: a shard's rows plus one compute
@@ -101,7 +169,6 @@ class ModelPredictor(Predictor):
         stores whose shards are smaller than ``chunk_size``."""
         import json
         import os
-        from collections import deque
 
         import jax
 
@@ -119,48 +186,33 @@ class ModelPredictor(Predictor):
         if store.count() == 0:
             raise ValueError(f"store {store.path} has no rows to predict")
 
-        buf: list[np.ndarray] = []     # feature rows awaiting a forward pass
-        owed: deque = deque()          # (shard_id, rows) awaiting outputs
-        ready: list[np.ndarray] = []   # predicted rows, FIFO
+        # One shard in = one prediction array out (predict_stream buffers
+        # rows across shard boundaries internally; only the final partial
+        # chunk pads). The column's files are written under a FRESH
+        # versioned physical name, and the manifest — the single source of
+        # truth for which files a column reads — swaps atomically at the
+        # end: a crash mid-stream leaves any pre-existing column fully
+        # intact (no per-shard renames over live files, which could mix two
+        # models' outputs). Superseded versions' files are orphaned, not
+        # deleted (readers of the old manifest may still hold them).
+        import uuid
+
+        physical = self.output_col
+        if self.output_col in store.columns:
+            physical = f"{self.output_col}.{uuid.uuid4().hex[:8]}"
         meta: dict = {}
-
-        def emit() -> None:
-            while owed and sum(map(len, ready)) >= owed[0][1]:
-                s, need = owed.popleft()
-                parts = []
-                while need:
-                    r = ready[0]
-                    if len(r) <= need:
-                        parts.append(ready.pop(0))
-                        need -= len(parts[-1])
-                    else:
-                        parts.append(r[:need])
-                        ready[0] = r[need:]
-                        need = 0
-                out = np.concatenate(parts, axis=0)
-                meta.update(dtype=str(out.dtype), shape=list(out.shape[1:]))
-                np.save(os.path.join(store.path,
-                                     _shard_file(s, self.output_col)), out)
-
-        for s, chunk in enumerate(sdf.iter_column_chunks(self.features_col)):
-            x = chunk[self.features_col]
-            owed.append((s, len(x)))
-            buf.append(x)
-            total = sum(map(len, buf))
-            take = (total // self.chunk_size) * self.chunk_size
-            if take:
-                xs = np.concatenate(buf, axis=0)
-                ready.append(self._predict_array(xs[:take]))
-                buf = [xs[take:]] if take < total else []
-                emit()
-        if buf:
-            ready.append(self._predict_array(np.concatenate(buf, axis=0)))
-        emit()
+        source = (chunk[self.features_col]
+                  for chunk in sdf.iter_column_chunks(self.features_col))
+        for s, out in enumerate(self.predict_stream(source)):
+            meta.update(dtype=str(out.dtype), shape=list(out.shape[1:]))
+            np.save(os.path.join(store.path, _shard_file(s, physical)), out)
 
         manifest = dict(store.manifest)
         manifest["columns"] = dict(manifest["columns"])
-        manifest["columns"][self.output_col] = {
-            "dtype": meta["dtype"], "shape": meta["shape"]}
+        colspec = {"dtype": meta["dtype"], "shape": meta["shape"]}
+        if physical != self.output_col:
+            colspec["file"] = physical
+        manifest["columns"][self.output_col] = colspec
         tmp = os.path.join(store.path, ".manifest.json.tmp")
         with open(tmp, "w") as f:
             json.dump(manifest, f)
@@ -192,7 +244,9 @@ class StreamingPredictor(ModelPredictor):
     predictions). The TPU-native equivalent takes any iterator of feature
     microbatches — a generator over a socket, a queue drained by a consumer
     thread, a file tail — and yields one prediction array per input
-    microbatch, in order.
+    microbatch, in order (``predict_stream``; the machinery lives on
+    :class:`ModelPredictor`, where the sharded-store path reuses it with
+    shards as the microbatches).
 
     Records accumulate into ``chunk_size`` rows before a forward pass runs, so
     arbitrary producer batch sizes still hit one compiled fixed-shape
@@ -201,63 +255,6 @@ class StreamingPredictor(ModelPredictor):
     ``StreamingClassPredictor`` below emits class ids exactly like
     :class:`ClassPredictor` does for dataframes.
     """
-
-    def predict_stream(self, source):
-        """Yield ``predictions`` (one array per source microbatch, in order).
-
-        ``source`` yields feature arrays shaped ``[n, ...]`` (n may vary per
-        item; wrap single records as length-1 batches).
-        """
-        from collections import deque
-
-        sizes: deque[int] = deque()  # rows per emitted-pending microbatch
-        pending: list[np.ndarray] = []  # rows awaiting a forward pass
-        ready: list[np.ndarray] = []  # predicted rows, FIFO
-
-        def pending_rows() -> int:
-            return sum(len(r) for r in pending)
-
-        def compute(flush: bool) -> None:
-            x = np.concatenate(pending, axis=0) if pending else None
-            if x is None or not len(x):
-                return
-            take = (len(x) // self.chunk_size) * self.chunk_size
-            if flush:
-                take = len(x)  # pad out the final partial chunk
-            if take == 0:
-                return
-            ready.append(self._predict_array(x[:take]))
-            pending.clear()
-            if take < len(x):
-                pending.append(x[take:])
-
-        def drain():
-            while sizes:
-                need = sizes[0]
-                if sum(len(r) for r in ready) < need:
-                    return
-                parts = []
-                while need:
-                    r = ready[0]
-                    if len(r) <= need:
-                        parts.append(ready.pop(0))
-                        need -= len(parts[-1])
-                    else:
-                        parts.append(r[:need])
-                        ready[0] = r[need:]
-                        need = 0
-                sizes.popleft()
-                yield np.concatenate(parts, axis=0)
-
-        for microbatch in source:
-            mb = np.asarray(microbatch)
-            sizes.append(len(mb))
-            pending.append(mb)
-            if pending_rows() >= self.chunk_size:
-                compute(flush=False)
-            yield from drain()
-        compute(flush=True)
-        yield from drain()
 
 
 class StreamingClassPredictor(StreamingPredictor, ClassPredictor):

@@ -221,3 +221,17 @@ def test_rounds_per_program_equivalence():
 
 def test_transformer_trainer_alias():
     assert TransformerTrainer is ParallelTrainer
+
+
+def test_parallel_trainer_from_sharded_store(tmp_path):
+    """Out-of-core flagship: a TransformerLM trains over a dp×tp mesh from a
+    disk-backed sharded store (single-process; rows gathered per round)."""
+    from distkeras_tpu.data.shards import ShardedDataFrame, write_shards
+
+    df = _data(n=256)
+    write_shards(tmp_path, {"features": np.asarray(df["features"]),
+                            "label": np.asarray(df["label"])},
+                 rows_per_shard=64)
+    t = _trainer({"data": -1, "model": 2})
+    t.train(ShardedDataFrame(tmp_path))
+    assert t.get_history()[-1] < t.get_history()[0]

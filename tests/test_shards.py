@@ -278,6 +278,49 @@ def test_sharded_predict_and_evaluate(tmp_path):
         assert a == pytest.approx(b, rel=1e-5), type(ev).__name__
 
 
+def test_sharded_repredict_versions_column(tmp_path):
+    """Re-predicting an existing output column writes FRESH physical files
+    and swaps the manifest atomically — a crash mid-stream can never mix two
+    models' outputs under one column."""
+    from distkeras_tpu import ModelPredictor
+    from distkeras_tpu.models import Model
+    from distkeras_tpu.models.mlp import MLP
+
+    x, y = _blobs(n=64)
+    write_shards(tmp_path, {"features": x, "label": y}, rows_per_shard=32)
+    m1 = Model.build(MLP(hidden=(8,), num_outputs=3),
+                     np.zeros((1, 4), np.float32), seed=0)
+    m2 = Model.build(MLP(hidden=(8,), num_outputs=3),
+                     np.zeros((1, 4), np.float32), seed=1)
+    s1 = ModelPredictor(m1).predict(ShardedDataFrame(tmp_path))
+    v1 = s1.store.gather("prediction", np.arange(64))
+    s2 = ModelPredictor(m2).predict(s1)
+    v2 = s2.store.gather("prediction", np.arange(64))
+    assert not np.allclose(v1, v2)  # new model's outputs are live
+    # the second version lives under a versioned physical file name
+    spec = s2.store.columns["prediction"]
+    assert spec.get("file", "prediction") != "prediction"
+    np.testing.assert_allclose(v2, np.asarray(m2.predict(x)), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_predict_stream_handles_empty_microbatches():
+    from distkeras_tpu.predictors import StreamingPredictor
+    from distkeras_tpu.models import Model
+    from distkeras_tpu.models.mlp import MLP
+
+    x, _ = _blobs(n=24)
+    model = Model.build(MLP(hidden=(8,), num_outputs=3),
+                        np.zeros((1, 4), np.float32), seed=0)
+    p = StreamingPredictor(model, chunk_size=16)
+    source = [x[:8], x[:0], x[8:24], np.empty((0,), np.float32)]
+    outs = list(p.predict_stream(iter(source)))
+    assert [len(o) for o in outs] == [8, 0, 16, 0]
+    np.testing.assert_allclose(np.concatenate([o for o in outs if len(o)]),
+                               np.asarray(model.predict(x)), rtol=1e-5,
+                               atol=1e-6)
+
+
 def test_sharded_predict_buffers_across_small_shards(tmp_path):
     """Shards smaller than chunk_size buffer into full compute chunks — only
     the final partial chunk is padded (no per-shard FLOP multiplication) —
