@@ -409,6 +409,9 @@ def stage_round(engine, plan, r: int):
     Single-process, the full ``round`` gather IS the local gather (every
     shard is addressable), so the plain path serves both."""
     if getattr(plan, "is_local", False) and jax.process_count() > 1:
+        hook = getattr(engine, "_stage_local_round", None)
+        if hook is not None:  # step engines: locality by dp rank, own specs
+            return hook(plan, r)
         lw = local_worker_ids(engine.mesh,
                               getattr(engine, "workers_per_chip", 1))
         xs, ys = plan.round_local(r, lw)
@@ -421,6 +424,10 @@ def stage_round(engine, plan, r: int):
 def stage_block(engine, plan, rs) -> tuple:
     """Stage a ``[R, W, K, B, ...]`` block of rounds (worker axis at dim 1)."""
     spec = P(None, DATA_AXIS)
+    if (getattr(plan, "is_local", False) and jax.process_count() > 1
+            and hasattr(engine, "_stage_local_block")):
+        # Step engines: locality by dp rank, engine-owned specs.
+        return engine._stage_local_block(plan, rs)
     if hasattr(engine, "_put_block"):
         # Step-engine adapters shard the batch axis, not a worker axis —
         # the engine owns its block spec (see parallel/runner.py).

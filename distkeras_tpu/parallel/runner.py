@@ -25,7 +25,19 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from distkeras_tpu.runtime.mesh import put_global
+from distkeras_tpu.runtime.mesh import DATA_AXIS, put_global
+
+
+def local_dp_ranks(mesh) -> list[int]:
+    """The ``data``-axis coordinates covered by THIS process's devices on an
+    N-D mesh. Model/seq-parallel peers of one dp rank share the same batch
+    rows, so this is the unit of data locality for step engines (several
+    devices may map to one rank; several ranks may map to one process)."""
+    axis = mesh.axis_names.index(DATA_AXIS)
+    pi = jax.process_index()
+    ranks = {idx[axis] for idx in np.ndindex(mesh.devices.shape)
+             if mesh.devices[idx].process_index == pi}
+    return sorted(ranks)
 
 
 class WindowedStepEngine:
@@ -48,16 +60,29 @@ class WindowedStepEngine:
         self.num_workers = 1
         #: real chip count, for samples/s/chip metrics.
         self.num_chips = int(self.mesh.devices.size)
+        self.dp_size = int(inner.mesh.shape.get(DATA_AXIS, 1))
         self._multi_fns: dict = {}
         step_core = inner._step_core
 
         def round_core(state, xs, ys):
-            # xs: [1, K, B_global, ...] — squeeze the worker axis, scan steps.
+            # xs: [Wp, K, b, ...]. Wp=1 is the plain global batch; a sharded
+            # multi-process plan uses Wp=dp "workers" whose rank-major rows
+            # merge into the batch axis — block w of the merged [K, Wp*b]
+            # batch is exactly what the P(data) sharding hands dp rank w, so
+            # the merge is a sharding-preserving reshape, no communication.
+            def merge(a):
+                if a.shape[0] == 1:
+                    return a[0]
+                moved = jnp.swapaxes(a, 0, 1)  # [K, Wp, b, ...]
+                return moved.reshape(
+                    (moved.shape[0], a.shape[0] * moved.shape[2])
+                    + moved.shape[3:])
+
             def body(st, xy):
                 st2, loss = step_core(st, xy[0], xy[1])
                 return st2, loss
 
-            state, losses = lax.scan(body, state, (xs[0], ys[0]))
+            state, losses = lax.scan(body, state, (merge(xs), merge(ys)))
             return state, jnp.mean(losses)
 
         self._round_core = round_core
@@ -72,32 +97,70 @@ class WindowedStepEngine:
     def init_state(self):
         return self.inner.init_state()
 
-    def _batch_sharding(self, extra_axes: int) -> NamedSharding:
-        """The inner step's batch spec with ``extra_axes`` leading None axes
-        (worker axis, and for blocked programs the round axis)."""
+    def _batch_sharding(self, lead_axes: int, Wp: int = 1) -> NamedSharding:
+        """Sharding for a ``[..lead.., Wp, K, b, ...]`` batch stack. Wp=1:
+        the batch-dim spec applies at the b axis. Wp=dp: the data axis moves
+        to the worker-major axis (rank w's block), the b axis is unsharded,
+        and any further axes (e.g. seq over L) keep the inner spec."""
         spec = self.inner.batch_sharding().spec
-        return NamedSharding(self.mesh, P(*([None] * extra_axes), *spec))
+        lead = [None] * lead_axes
+        if Wp == 1:
+            return NamedSharding(self.mesh, P(*lead, None, None, *spec))
+        return NamedSharding(self.mesh, P(*lead, spec[0], None, None,
+                                          *spec[1:]))
 
     def _put_batch(self, xs, ys):
-        sh = self._batch_sharding(2)  # [1, K, B, ...]
+        sh = self._batch_sharding(0, Wp=xs.shape[0])  # [Wp, K, b, ...]
         return put_global(xs, sh), put_global(ys, sh)
 
     def _put_block(self, xs, ys):
-        sh = self._batch_sharding(3)  # [R, 1, K, B, ...]
+        sh = self._batch_sharding(1, Wp=xs.shape[1])  # [R, Wp, K, b, ...]
         return put_global(xs, sh), put_global(ys, sh)
+
+    # -- sharded-store locality (multi-process) ------------------------------
+    @property
+    def _local_ranks(self) -> list[int]:
+        # Constant for the engine's lifetime; the N-D device-grid scan is
+        # Python-loop work that must not run per staged round.
+        if not hasattr(self, "_local_ranks_cache"):
+            self._local_ranks_cache = local_dp_ranks(self.mesh)
+        return self._local_ranks_cache
+
+    def _stage_local_round(self, plan, r):
+        from distkeras_tpu.parallel.engine import put_worker_local
+
+        lw = self._local_ranks
+        xs, ys = plan.round_local(r, lw)
+        sh = self._batch_sharding(0, Wp=plan.num_workers)
+        put = lambda a: put_worker_local(
+            a, self.mesh, plan.num_workers, lw, 0, sh.spec)
+        return put(xs), put(ys)
+
+    def _stage_local_block(self, plan, rs):
+        from distkeras_tpu.parallel.engine import put_worker_local
+
+        lw = self._local_ranks
+        batches = [plan.round_local(r, lw) for r in rs]
+        xs = np.stack([b[0] for b in batches])
+        ys = np.stack([b[1] for b in batches])
+        sh = self._batch_sharding(1, Wp=plan.num_workers)
+        put = lambda a: put_worker_local(
+            a, self.mesh, plan.num_workers, lw, 1, sh.spec)
+        return put(xs), put(ys)
 
     def run(self, plan, state=None, start_round: int = 0,
             on_round: Optional[Callable] = None,
             rounds_per_program: "int | str" = 1):
-        if plan.num_workers != 1:
+        multiproc_sharded = (getattr(plan, "is_local", False)
+                             and jax.process_count() > 1)
+        allowed = ({self.dp_size} if multiproc_sharded
+                   else {1, self.dp_size})
+        if plan.num_workers not in allowed:
             raise ValueError(
-                f"step-engine plans use num_workers=1 (the whole mesh is one "
-                f"logical worker); got a plan built for {plan.num_workers}")
-        if getattr(plan, "is_local", False) and jax.process_count() > 1:
-            raise NotImplementedError(
-                "multi-process sharded-store staging for model-parallel "
-                "engines is not wired yet; use an in-RAM DataFrame (the "
-                "batch axis, not a worker axis, is what's sharded here)")
+                f"step-engine plan num_workers must be in {sorted(allowed)} "
+                f"(1 = whole-mesh batch; {self.dp_size} = one per dp rank, "
+                f"required for multi-process sharded stores); got "
+                f"{plan.num_workers}")
         if state is None:
             state = self.init_state()
         from distkeras_tpu.parallel.engine import run_rounds

@@ -206,15 +206,22 @@ class Trainer:
                 true_round = int(meta.get("round", latest))
                 saved_w = meta.get("num_workers")
                 cur_w = getattr(engine, "num_workers", None)
+                saved_spr = meta.get("samples_per_round")
                 resized = (saved_w is not None and cur_w is not None
                            and saved_w != cur_w)
-                if resized:
-                    # Round indices are topology-dependent: carry over DATA
-                    # progress (samples consumed), not the raw counter. Old
-                    # checkpoints without samples_per_round meta fall back to
-                    # the worker-count ratio (exact when batch/window are
-                    # unchanged, the common pod-resize case).
-                    saved_spr = meta.get("samples_per_round")
+                # Round indices are meaningless across schedules whose
+                # per-round sample count changed — a worker-count resize,
+                # OR a topology-dependent plan (e.g. a step engine's
+                # per-dp-rank sharded schedule) whose spr moved while the
+                # engine's logical worker count stayed 1.
+                spr_changed = (saved_spr is not None
+                               and saved_spr != plan.samples_per_round)
+                if resized or spr_changed:
+                    # Carry over DATA progress (samples consumed), not the
+                    # raw counter. Old checkpoints without samples_per_round
+                    # meta fall back to the worker-count ratio (exact when
+                    # batch/window are unchanged, the common pod-resize
+                    # case).
                     num = saved_spr if saved_spr else saved_w
                     den = plan.samples_per_round if saved_spr else cur_w
                     start = min(((true_round + 1) * num) // den,
@@ -247,6 +254,12 @@ class Trainer:
                             f"{saved_w} on num_workers={cur_w}: state "
                             "restored exactly; data progress rescaled",
                             stacklevel=2)
+                    elif spr_changed:
+                        warnings.warn(
+                            "resuming under a schedule whose samples/round "
+                            f"changed ({saved_spr} -> "
+                            f"{plan.samples_per_round}): state restored "
+                            "exactly; data progress rescaled", stacklevel=2)
                     else:
                         start = min(true_round + 1, plan.num_rounds)
                 step_offset = (latest + 1) - start
@@ -686,9 +699,23 @@ class ParallelTrainer(Trainer):
     def train(self, dataframe: DataFrame, shuffle: bool = False) -> Model:
         self.record_training_start()
         engine = self._build_engine()
+        # Multi-process sharded stores plan one "worker" per dp rank so each
+        # host stages only its own ranks' rows (the engine merges the
+        # rank-major stack back into the global batch — a sharding-preserving
+        # reshape). Everything else uses the whole-mesh single-worker plan.
+        plan_workers, per_worker_batch = 1, self.batch_size
+        if (getattr(dataframe, "is_sharded", False)
+                and jax.process_count() > 1):
+            plan_workers = engine.dp_size
+            if self.batch_size % plan_workers:
+                raise ValueError(
+                    f"batch_size={self.batch_size} must divide by the data-"
+                    f"parallel size {plan_workers} for multi-process sharded "
+                    "stores (rows are staged per dp rank)")
+            per_worker_batch = self.batch_size // plan_workers
         plan = make_batches(
-            dataframe, self.features_col, self.label_col, self.batch_size,
-            num_workers=1, window=self.steps_per_program,
+            dataframe, self.features_col, self.label_col, per_worker_batch,
+            num_workers=plan_workers, window=self.steps_per_program,
             num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed,
         )
         state = self._execute(engine, plan)

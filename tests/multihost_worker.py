@@ -67,21 +67,34 @@ def main() -> None:
 
         if os.environ.get("DK_DISJOINT") == "1":
             store = ShardStore.open(shard_dir)
-            # Logical workers per chip, matching the engine's mapping
-            # (parallel/engine.local_worker_ids): W <= chips puts worker w on
-            # chip w (submesh); W beyond the chip count multiplexes m per
-            # chip as [c*m, (c+1)*m).
-            W = int(os.environ.get("DK_NUM_WORKERS", jax.device_count()))
             pid = jax.process_index()
-            if W <= jax.device_count():
-                local_workers = [w for w, dev in enumerate(jax.devices()[:W])
-                                 if dev.process_index == pid]
+            if os.environ.get("DK_TRAINER") == "parallel":
+                # Step engines: locality unit = dp RANK on an N-D (data,
+                # model) mesh; model-parallel peers of a rank share rows.
+                # Use the engine's own mapping on the actual mesh so test
+                # and trainer can never drift.
+                from distkeras_tpu.parallel.runner import local_dp_ranks
+                from distkeras_tpu.runtime.mesh import hybrid_mesh
+
+                W = int(os.environ.get("DK_DP", "2"))
+                local_workers = local_dp_ranks(
+                    hybrid_mesh({"data": W, "model": -1}))
             else:
-                m = W // jax.device_count()
-                local_workers = [c * m + j
-                                 for c, dev in enumerate(jax.devices())
-                                 if dev.process_index == pid
-                                 for j in range(m)]
+                # Data-parallel trainers: logical workers per chip, matching
+                # parallel/engine.local_worker_ids — W <= chips puts worker w
+                # on chip w (submesh); beyond the chip count multiplexes m
+                # per chip as [c*m, (c+1)*m).
+                W = int(os.environ.get("DK_NUM_WORKERS", jax.device_count()))
+                if W <= jax.device_count():
+                    local_workers = [
+                        w for w, dev in enumerate(jax.devices()[:W])
+                        if dev.process_index == pid]
+                else:
+                    m = W // jax.device_count()
+                    local_workers = [c * m + j
+                                     for c, dev in enumerate(jax.devices())
+                                     if dev.process_index == pid
+                                     for j in range(m)]
             parts = worker_partition(store.count(), W)
             needed = set()
             for w in local_workers:
@@ -126,13 +139,28 @@ def main() -> None:
         resume=os.environ.get("DK_RESUME") == "1",
         on_round=fault,
     )
-    # DK_TRAINER selects the discipline: "sync" (default) exercises the
-    # per-step-pmean path, "adag" the async center-variable fold — both must
+    # DK_TRAINER selects the path: "sync" (default) exercises the
+    # per-step-pmean engine, "adag" the async center-variable fold,
+    # "parallel" the ParallelTrainer step engines (dp x tp mesh) — all must
     # work across a multi-process DCN mesh.
     if os.environ.get("DK_TRAINER") == "adag":
         from distkeras_tpu import ADAG
 
         trainer = ADAG(model, communication_window=4, **common)
+    elif os.environ.get("DK_TRAINER") == "parallel":
+        from distkeras_tpu import ParallelTrainer
+
+        dp = int(os.environ.get("DK_DP", "2"))
+        trainer = ParallelTrainer(
+            model, parallel={"data": dp, "model": -1},
+            worker_optimizer=common.get("worker_optimizer", "sgd"),
+            loss=common["loss"], batch_size=common["batch_size"] * 2,
+            num_epoch=common["num_epoch"],
+            learning_rate=common["learning_rate"],
+            steps_per_program=4,
+            checkpoint_dir=common["checkpoint_dir"],
+            checkpoint_every=common["checkpoint_every"],
+            resume=common["resume"], on_round=common["on_round"])
     else:
         trainer = SynchronousDistributedTrainer(model, **common)
     trained = trainer.train(df)

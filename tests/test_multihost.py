@@ -275,6 +275,53 @@ def test_disjoint_shards_with_multiplexed_workers(tmp_path):
 
 
 @pytest.mark.slow
+def test_parallel_trainer_disjoint_shards(tmp_path):
+    """Model-parallel x multi-host x out-of-core, composed: ParallelTrainer
+    on a 2-process dp(2) x tp(2) mesh trains from per-host disjoint shard
+    files — rows staged per dp RANK (model-parallel peers share rows), and
+    the run must match the replicated-store run exactly."""
+    import numpy as np
+
+    from distkeras_tpu.data.shards import write_shards
+
+    rng = np.random.default_rng(0)
+    n, d, c = 1024, 4, 3
+    centers = rng.normal(scale=4.0, size=(c, d))
+    y = rng.integers(0, c, size=n)
+    x = (centers[y] + rng.normal(scale=0.5, size=(n, d))).astype(np.float32)
+    store = tmp_path / "store"
+    # 512 rows/shard on dp=2: shard r == dp rank r's partition.
+    write_shards(store, {"features": x, "label": y.astype(np.int32)},
+                 rows_per_shard=512)
+    env = {"DK_SHARD_DIR": str(store), "DK_TRAINER": "parallel", "DK_DP": "2"}
+
+    full_dir = tmp_path / "full"
+    full_dir.mkdir()
+    _job, rcs = _launch_job(full_dir, env, timeout=900,
+                            job_name="pytest-ptrainer-full")
+    assert rcs == [0, 0], f"full-store run failed: rcs={rcs}"
+    full = _read_results(full_dir)
+
+    disj_dir = tmp_path / "disj"
+    disj_dir.mkdir()
+    _job, rcs = _launch_job(disj_dir, {**env, "DK_DISJOINT": "1"},
+                            timeout=900, job_name="pytest-ptrainer-disjoint")
+    assert rcs == [0, 0], f"disjoint run failed: rcs={rcs}"
+    disj = _read_results(disj_dir)
+
+    # Each process linked exactly its dp rank's shard (x2 columns) + manifest.
+    for i in range(2):
+        files = sorted(p.name for p in (disj_dir / f"shards_proc{i}").iterdir())
+        assert len(files) == 3, files
+    assert (disj_dir / "shards_proc1" / "shard-00001.features.npy").exists()
+
+    for r in full + disj:
+        assert r["accuracy"] > 0.85, r
+    assert disj[0]["history"] == pytest.approx(full[0]["history"], rel=1e-6)
+    assert disj[0]["history"] == pytest.approx(disj[1]["history"], rel=1e-6)
+
+
+@pytest.mark.slow
 def test_fault_injection_checkpoint_recovery(tmp_path):
     """Kill one host mid-training (hard abort, no cleanup — a preempted pod
     host), then relaunch the job with resume: the recovered run must finish

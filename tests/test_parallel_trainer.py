@@ -223,6 +223,28 @@ def test_transformer_trainer_alias():
     assert TransformerTrainer is ParallelTrainer
 
 
+def test_rank_major_plan_merges_to_global_batch():
+    """The Wp=dp worker-major batch stack (multi-process sharded staging)
+    must be program-identical to the Wp=1 global batch when the rows match:
+    the merge is a sharding-preserving reshape, not a different schedule."""
+    engine = _trainer({"data": -1, "model": 2})._build_engine()
+    dp = engine.dp_size
+    rng = np.random.default_rng(0)
+    K, B = 2, 16
+    xs1 = rng.integers(0, VOCAB, size=(1, K, B, SEQ)).astype(np.int32)
+    ys1 = rng.integers(0, VOCAB, size=(1, K, B, SEQ)).astype(np.int32)
+    b = B // dp
+    xs2 = np.stack([xs1[0, :, w * b:(w + 1) * b] for w in range(dp)])
+    ys2 = np.stack([ys1[0, :, w * b:(w + 1) * b] for w in range(dp)])
+
+    s1, l1 = engine._round_fn(engine.init_state(), *engine._put_batch(xs1, ys1))
+    s2, l2 = engine._round_fn(engine.init_state(), *engine._put_batch(xs2, ys2))
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, c in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), rtol=1e-5,
+                                   atol=1e-7)
+
+
 def test_parallel_trainer_from_sharded_store(tmp_path):
     """Out-of-core flagship: a TransformerLM trains over a dp×tp mesh from a
     disk-backed sharded store (single-process; rows gathered per round)."""
