@@ -104,6 +104,17 @@ class ShardWriter:
         self._shards.append(rows)
         self._buffered -= rows
 
+    def __enter__(self) -> "ShardWriter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # Only publish the manifest on clean exit: a partially-written store
+        # without a manifest is unreadable (fails loudly) rather than
+        # silently truncated. Tolerates an explicit close() inside the block
+        # (the way to get the returned manifest).
+        if exc_type is None and not self._closed:
+            self.close()
+
     def close(self) -> dict:
         """Flush the tail shard and write the manifest; returns the manifest."""
         if self._closed:

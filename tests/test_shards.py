@@ -63,6 +63,36 @@ def test_shard_writer_streaming_matches_oneshot(tmp_path):
         store.gather("features", np.arange(90)), x)
 
 
+def test_writer_context_manager_and_random_chunking(tmp_path):
+    """`with ShardWriter(...)` publishes on clean exit (and not on error);
+    arbitrary random append chunkings round-trip exactly."""
+    rng = np.random.default_rng(5)
+    x, y = _blobs(n=333, seed=5)
+    with ShardWriter(tmp_path / "ok", rows_per_shard=37) as w:
+        off = 0
+        while off < 333:
+            k = int(rng.integers(1, 50))
+            w.append(features=x[off:off + k], label=y[off:off + k])
+            off += k
+    store = ShardStore.open(tmp_path / "ok")
+    assert store.count() == 333
+    np.testing.assert_array_equal(store.gather("features", np.arange(333)), x)
+    np.testing.assert_array_equal(store.gather("label", np.arange(333)), y)
+
+    with pytest.raises(RuntimeError, match="boom"):
+        with ShardWriter(tmp_path / "bad", rows_per_shard=8) as w:
+            w.append(features=x[:16], label=y[:16])
+            raise RuntimeError("boom")
+    with pytest.raises(FileNotFoundError):  # no manifest published
+        ShardStore.open(tmp_path / "bad")
+
+    # Explicit close() inside the block (to grab the manifest) is tolerated.
+    with ShardWriter(tmp_path / "manual", rows_per_shard=8) as w:
+        w.append(features=x[:16], label=y[:16])
+        manifest = w.close()
+    assert manifest["num_rows"] == 16
+
+
 def test_writer_rejects_schema_drift(tmp_path):
     w = ShardWriter(tmp_path, rows_per_shard=8)
     w.append(features=np.zeros((4, 3), np.float32))
