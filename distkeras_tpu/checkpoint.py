@@ -106,16 +106,32 @@ class Checkpointer:
             os.replace(tmp, os.path.join(meta_dir, f"{step}.json"))
             # GC meta for steps the manager has garbage-collected, so a stale
             # topology can never be read for a re-used step number. Also
-            # reap tmp files orphaned by a crash between write and rename
-            # (skipping this very step's in-flight tmps on other processes).
+            # reap tmp files orphaned by a crash between write and rename:
+            # this process's own (``.p{index}.tmp``) immediately when not for
+            # the current step, a peer's only once old — a live peer's tmp
+            # for a concurrent step must never be unlinked from under its
+            # os.replace, but a tmp from a process index that never returns
+            # (elastic shrink after a crash) must not leak forever.
+            import time
+
+            own_tmp = f".p{jax.process_index()}.tmp"
             live = {f"{s_}.json" for s_ in self._mngr.all_steps()}
             for name in os.listdir(meta_dir):
-                stale = ((name.endswith(".json") and name not in live)
-                         or (name.endswith(".tmp")
-                             and not name.startswith(f".{step}.json.")))
+                path = os.path.join(meta_dir, name)
+                if name.endswith(".json"):
+                    stale = name not in live
+                elif name.endswith(own_tmp):
+                    stale = not name.startswith(f".{step}.json.")
+                elif name.endswith(".tmp"):
+                    try:
+                        stale = time.time() - os.path.getmtime(path) > 3600
+                    except OSError:
+                        stale = False
+                else:
+                    stale = False
                 if stale:
                     try:
-                        os.remove(os.path.join(meta_dir, name))
+                        os.remove(path)
                     except OSError:
                         pass
         if wait:

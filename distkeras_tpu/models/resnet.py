@@ -12,7 +12,8 @@ and norms ``GN_k`` — a ONE-TIME break from the earlier auto-generated
 ``BottleneckBlock_i/GroupNorm_k`` paths, required so ``remat=True`` (which
 changes flax's auto prefix) cannot silently re-draw init or orphan
 checkpoints across remat settings. Checkpoints written before this rename
-need their ResNet param paths remapped on restore.
+need their ResNet param paths remapped on restore —
+:func:`remap_legacy_params` does it.
 """
 
 from __future__ import annotations
@@ -143,6 +144,60 @@ def resnet50(num_outputs: int = 1000, seed: int = 0, remat: bool = False,
     module = ResNet(stage_sizes=(3, 4, 6, 3), num_outputs=num_outputs,
                     remat=remat, norm_impl=norm_impl)
     return Model.build(module, jnp.zeros((1, 224, 224, 3), jnp.float32), seed=seed)
+
+
+def remap_legacy_params(params, stage_sizes: tuple = (3, 4, 6, 3)):
+    """Remap a pre-round-3 ResNet param tree (flax auto-generated
+    ``BottleneckBlock_n`` / ``GroupNorm_k`` module paths) to the current
+    explicit ``stage{i}_block{j}`` / ``GN_k`` layout.
+
+    Use when restoring a checkpoint written before the round-3 rename::
+
+        old = ckpt.restore_host(legacy_target)
+        model = model.with_params(remap_legacy_params(old, module.stage_sizes))
+
+    Raises ``KeyError`` with guidance if the tree has no legacy-named
+    modules at all (e.g. an already-current tree, or a remat-era auto
+    prefix), so a no-op remap cannot masquerade as a successful migration.
+    """
+    if not detect_legacy_layout(params):
+        raise KeyError(
+            "params tree has no legacy 'BottleneckBlock_n'/'GroupNorm_k' "
+            f"modules (top-level keys: {sorted(dict(params))}). Either it is "
+            "already in the current stage{i}_block{j}/GN_k layout (no remap "
+            "needed), or it was written under a different auto-naming (e.g. "
+            "remat-wrapped modules) and needs a hand-written key map.")
+    order = [f"stage{i}_block{j}"
+             for i, n in enumerate(stage_sizes) for j in range(n)]
+
+    def rename_gn(tree):
+        return {(k.replace("GroupNorm_", "GN_", 1)
+                 if k.startswith("GroupNorm_") else k): v
+                for k, v in tree.items()}
+
+    out = {}
+    for k, v in dict(params).items():
+        if k.startswith("BottleneckBlock_"):
+            n = int(k.rsplit("_", 1)[1])
+            if n >= len(order):
+                raise KeyError(
+                    f"{k} has no slot in stage_sizes={stage_sizes} "
+                    f"({len(order)} blocks) — pass the module's actual "
+                    "stage_sizes")
+            out[order[n]] = rename_gn(dict(v))
+        elif k.startswith("GroupNorm_"):
+            out[k.replace("GroupNorm_", "GN_", 1)] = v
+        else:
+            out[k] = v
+    return out
+
+
+def detect_legacy_layout(params) -> bool:
+    """True if ``params`` is a pre-round-3 ResNet tree (auto-generated block
+    names) — for restore-path callers that want to raise with remap
+    instructions instead of a bare missing-key error."""
+    return any(k.startswith(("BottleneckBlock_", "GroupNorm_"))
+               for k in dict(params))
 
 
 def tiny_resnet(num_outputs: int = 10, seed: int = 0) -> Model:

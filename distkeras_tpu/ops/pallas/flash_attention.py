@@ -280,9 +280,10 @@ def flash_attention(q, k, v, block_size: int = 128, block_k: int | None = None,
                     interpret: bool = False):
     """Causal FlashAttention. ``q, k, v``: [B, L, H, D], q pre-scaled by
     1/sqrt(D). Returns [B, L, H, D]. ``block_size`` is the q-block;
-    ``block_k`` (default ``min(8*block_size, L)``) is the inner k-chunk —
-    large k-chunks keep the MXU busy when d_head is small (see module doc).
-    ``L`` must be divisible by both.
+    ``block_k`` is the inner k-chunk — by default the largest multiple of
+    ``block_size`` up to ``8*block_size`` that divides ``L`` (e.g. L=1280,
+    block 128 -> 640, not 1024). Large k-chunks keep the MXU busy when
+    d_head is small (see module doc). ``L`` must be divisible by both.
     """
     B, L, H, D = q.shape
     if block_k is None:

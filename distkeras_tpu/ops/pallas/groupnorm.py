@@ -44,19 +44,57 @@ def _group_matrix(C: int, G: int, fold: int = 1) -> np.ndarray:
     return M
 
 
-def _num_chunks(N: int, C: int, budget_bytes: float = 3e5) -> int:
+#: f32 chunk-temporary size above which the kernel declines the shape and
+#: group_norm falls back to XLA. The soft budget below it is a preference
+#: (register/stack pressure); known-good ResNet shapes run up to ~800 KB over
+#: it, so the hard line sits well above those but below plan-blowing sizes.
+_HARD_CHUNK_BYTES = 2e6
+
+
+def _num_chunks(N: int, C: int, budget_bytes: float = 3e5) -> int | None:
     """Chunk the [N, C] slab's float32 work so per-chunk temporaries fit the
     scoped-VMEM stack (the bf16 slab itself stays resident; chunked loads are
     VMEM->VREG, costing no HBM traffic). Chunk starts stay sublane-aligned
-    (CK % 8 == 0) so dynamic slices lower cleanly."""
-    best = 1
-    for cand in (2, 4, 8, 16, 32):  # least-split first: fewest loop trips
+    (CK % 8 == 0; a single chunk starts at 0 and needs no alignment) so
+    dynamic slices lower cleanly. The soft ``budget_bytes`` is a preference:
+    the most-split aligned candidate is used even over it (measured fine on
+    chip for ResNet's 400-800 KB cases), but past ``_HARD_CHUNK_BYTES``
+    returns ``None`` — callers fall back to the XLA impl instead of blowing
+    the scoped-VMEM plan at compile time (r3 advisor)."""
+    best = None
+    for cand in (1, 2, 4, 8, 16, 32):  # least-split first: fewest loop trips
         ck = N // cand
-        if N % cand == 0 and ck % 8 == 0:
-            best = cand
+        if N % cand == 0 and (cand == 1 or ck % 8 == 0):
+            best = cand  # ends at the most-split aligned candidate
             if ck * C * 4 <= budget_bytes:
                 return cand
-    return best  # most-split aligned candidate even if over budget
+    if best is not None and (N // best) * C * 4 <= _HARD_CHUNK_BYTES:
+        return best
+    return None
+
+
+def _lane_fold(N: int, C: int) -> int:
+    """Lane-fold factor for C<128 layers: view [B, N, C] as [B, N/f, C*f] so
+    every lane is busy (pure reshape in row-major NHWC)."""
+    fold = 1
+    while C * fold < 128 and N % (fold * 2) == 0:
+        fold *= 2
+    return fold
+
+
+def _xla_group_norm(x3, gamma, beta, groups: int, relu: bool):
+    """flax-equivalent GroupNorm(+ReLU) in plain HLO: float32 stats, biased
+    variance, eps 1e-6 — the fallback for shapes where no sublane-aligned
+    VMEM chunking exists for the Pallas kernel."""
+    B, N, C = x3.shape
+    xf = x3.astype(jnp.float32).reshape(B, N, groups, C // groups)
+    mean = xf.mean((1, 3), keepdims=True)
+    var = ((xf - mean) ** 2).mean((1, 3), keepdims=True)
+    y = ((xf - mean) * lax.rsqrt(var + 1e-6)).reshape(B, N, C)
+    y = y * gamma + beta
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x3.dtype)
 
 
 def _expand(v, M):
@@ -185,9 +223,7 @@ def _make_group_norm(groups: int, relu: bool, interpret: bool):
         lane is busy (pure reshape, no data movement in row-major NHWC);
         tile gamma/beta and the group matrix to match."""
         B, N, C = x.shape
-        fold = 1
-        while C * fold < 128 and N % (fold * 2) == 0:
-            fold *= 2
+        fold = _lane_fold(N, C)
         Cf, Nf = C * fold, N // fold
         xf = x.reshape(B, Nf, Cf)
         g = jnp.tile(gamma, fold).reshape(1, Cf)
@@ -269,5 +305,13 @@ def group_norm(x, gamma, beta, *, groups: int, relu: bool = False,
         raise ValueError(f"C={C} not divisible by groups={groups}")
     B = shape[0]
     x3 = x.reshape(B, -1, C)
-    y = _make_group_norm(groups, relu, interpret)(x3, gamma, beta)
+    N = x3.shape[1]
+    fold = _lane_fold(N, C)
+    if _num_chunks(N // fold, C * fold) is None:
+        # No aligned chunking keeps the f32 temporaries under the hard
+        # scoped-VMEM line for this (unusual) slab shape — plain HLO
+        # instead of a plan-blowing kernel.
+        y = _xla_group_norm(x3, gamma, beta, groups, relu)
+    else:
+        y = _make_group_norm(groups, relu, interpret)(x3, gamma, beta)
     return y.reshape(shape)

@@ -63,6 +63,29 @@ class ModelPredictor(Predictor):
         self._params = put_global(self.model.params, rep)
         self._state = put_global(state, rep)
         self._shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        self._empty_block_cache: Optional[np.ndarray] = None
+
+    def _empty_block(self, feature_hint: Optional[np.ndarray] = None) -> np.ndarray:
+        """Zero-row block with this predictor's exact output tail shape/dtype.
+
+        Derived abstractly (``jax.eval_shape`` on the forward, then the
+        subclass ``_postprocess`` on the zero-row array) so empty stream polls
+        concatenate cleanly with real prediction blocks. The input spec comes
+        from the model's build-time ``sample_spec``, or from a seen feature
+        microbatch when the model was deserialized without one.
+        """
+        if self._empty_block_cache is None:
+            spec = (self.model.sample_spec or (None,))[0]
+            if spec is None and feature_hint is not None:
+                spec = jax.ShapeDtypeStruct(np.shape(feature_hint),
+                                            np.asarray(feature_hint).dtype)
+            if spec is None:
+                return np.empty((0,), np.float32)  # nothing to infer from yet
+            x = jax.ShapeDtypeStruct((1,) + tuple(spec.shape[1:]), spec.dtype)
+            out = jax.eval_shape(self.model.predict, x)
+            self._empty_block_cache = self._postprocess(
+                np.zeros((0,) + tuple(out.shape[1:]), out.dtype))
+        return self._empty_block_cache
 
     def _postprocess(self, out: np.ndarray) -> np.ndarray:
         """Row-wise output transform hook (identity here; softmax/argmax in
@@ -104,6 +127,7 @@ class ModelPredictor(Predictor):
         sizes: deque[int] = deque()  # rows per emitted-pending microbatch
         pending: list[np.ndarray] = []  # rows awaiting a forward pass
         ready: list[np.ndarray] = []  # predicted rows, FIFO
+        feat_hint: list = [None]  # last seen microbatch WITH feature dims
 
         def pending_rows() -> int:
             return sum(len(r) for r in pending)
@@ -130,7 +154,7 @@ class ModelPredictor(Predictor):
                     # emit an empty row block with the output tail shape.
                     sizes.popleft()
                     yield (ready[0][:0] if ready
-                           else np.empty((0,), np.float32))
+                           else self._empty_block(feat_hint[0]))
                     continue
                 if sum(len(r) for r in ready) < need:
                     return
@@ -150,7 +174,12 @@ class ModelPredictor(Predictor):
         for microbatch in source:
             mb = np.asarray(microbatch)
             sizes.append(len(mb))
-            if len(mb):  # an empty poll has no rows (and no feature dims)
+            if mb.ndim > 1:
+                # Even a zero-row block carries the feature tail (e.g. an
+                # empty shard's [0, d] column) — keep it as the spec hint
+                # for empty output blocks on spec-less models.
+                feat_hint[0] = mb
+            if len(mb):  # an empty poll from a raw stream has no rows
                 pending.append(mb)
             if pending_rows() >= self.chunk_size:
                 compute(flush=False)

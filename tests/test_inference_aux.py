@@ -81,6 +81,31 @@ def test_streaming_class_predictor_small_trickle():
     np.testing.assert_array_equal(np.concatenate(outs), expect)
 
 
+def test_streaming_empty_polls_have_output_tail_shape():
+    """Empty microbatches (empty stream polls) must yield zero-row blocks with
+    the predictor's OUTPUT tail shape/dtype — including before any row has
+    been computed — so concatenating all stream outputs works (r3 advisor)."""
+    df = small_df(n=5)
+    model = tiny_model()
+    x = np.asarray(df["features"])
+    empty = x[:0]
+    source = [empty, x[:2], empty, x[2:], empty]  # leading/mid/trailing polls
+
+    sp = StreamingPredictor(model, chunk_size=64)
+    outs = list(sp.predict_stream(iter(source)))
+    assert [len(o) for o in outs] == [0, 2, 0, 3, 0]
+    assert all(o.shape[1:] == (3,) for o in outs)  # logits tail, even empties
+    cat = np.concatenate(outs, axis=0)  # the advisor's failing operation
+    expect = np.asarray(model.predict(jnp.asarray(x)))
+    np.testing.assert_allclose(cat, expect, rtol=1e-5, atol=1e-5)
+
+    # Class predictor: empties must be () tail int — postprocess applies.
+    scp = StreamingClassPredictor(model, chunk_size=64)
+    outs = list(scp.predict_stream(iter([empty, x])))
+    assert outs[0].shape == (0,) and outs[0].dtype == np.int32
+    assert np.concatenate(outs).shape == (5,)
+
+
 def test_accuracy_evaluator_mixed_representations():
     logits = np.array([[2.0, 0.1, 0.0], [0.0, 3.0, 0.1], [0.1, 0.0, 1.0]])
     df = DataFrame({"prediction": logits, "label": np.array([0, 1, 0])})

@@ -36,6 +36,47 @@ def test_resnet_forward():
     assert m.predict(jnp.ones((2, 32, 32, 3))).shape == (2, 10)
 
 
+def test_resnet_legacy_param_remap():
+    """A pre-round-3 auto-named param tree remaps onto the explicit
+    stage{i}_block{j}/GN_k layout and predicts identically."""
+    from distkeras_tpu.models.resnet import (
+        detect_legacy_layout, remap_legacy_params)
+
+    m = tiny_resnet()  # stage_sizes=(1, 1)
+    order = ["stage0_block0", "stage1_block0"]
+
+    def to_legacy(params):  # inverse of the rename, for test fixture only
+        out = {}
+        for k, v in params.items():
+            if k in order:
+                out[f"BottleneckBlock_{order.index(k)}"] = {
+                    ik.replace("GN_", "GroupNorm_", 1): iv
+                    for ik, iv in v.items()}
+            elif k.startswith("GN_"):
+                out[k.replace("GN_", "GroupNorm_", 1)] = v
+            else:
+                out[k] = v
+        return out
+
+    legacy = to_legacy(m.params)
+    assert detect_legacy_layout(legacy) and not detect_legacy_layout(m.params)
+    remapped = remap_legacy_params(legacy, stage_sizes=(1, 1))
+    assert jax_tree_equal(remapped, m.params)
+    x = jnp.ones((2, 32, 32, 3))
+    np.testing.assert_array_equal(
+        np.asarray(m.with_params(remapped).predict(x)),
+        np.asarray(m.predict(x)))
+
+
+def jax_tree_equal(a, b) -> bool:
+    import jax
+
+    if jax.tree.structure(a) != jax.tree.structure(b):
+        return False
+    return all(np.array_equal(x, y)
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
 def test_transformer_remat_training_step_matches_dense():
     """remat=True must be a pure memory/FLOPs trade: identical forward AND
     identical one-step SGD update (jax.checkpoint recomputes, never changes

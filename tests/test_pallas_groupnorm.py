@@ -86,6 +86,41 @@ def _GroupNormRef(G):
     return f
 
 
+def test_unchunkable_shape_falls_back_to_xla():
+    """When no aligned chunking keeps f32 temporaries under the hard
+    scoped-VMEM line (r3 advisor: _num_chunks used to proceed unbounded),
+    group_norm must route to the HLO impl — and still be flax-exact."""
+    from distkeras_tpu.ops.pallas.groupnorm import _lane_fold, _num_chunks
+
+    # N = 8 * odd prime: only nck=1 is aligned, and the f32 chunk
+    # (N*C*4 ≈ 4.1 MB) is past the 2e6-byte hard line -> None.
+    N, C = 8 * 1009, 128
+    assert _lane_fold(N, C) == 1 and _num_chunks(N, C) is None
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(1, N, C)), jnp.float32)
+    gamma = jnp.asarray(rng.normal(size=C), jnp.float32)
+    beta = jnp.asarray(rng.normal(size=C), jnp.float32)
+    global _G
+    _G = 16
+    y = group_norm(x, gamma, beta, groups=16, relu=True, interpret=True)
+    y_ref = jax.nn.relu(_ref(x, gamma, beta))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=2e-5)
+
+
+def test_resnet50_slab_shapes_stay_fused():
+    """Every GN slab shape ResNet-50 (224 input) actually produces must keep
+    a valid chunking — the fallback is for pathological shapes only, not a
+    silent deoptimization of the kernel's own target model."""
+    from distkeras_tpu.ops.pallas.groupnorm import _lane_fold, _num_chunks
+
+    slabs = [(112 * 112, 64), (56 * 56, 64), (56 * 56, 256),
+             (28 * 28, 128), (28 * 28, 512), (14 * 14, 256),
+             (14 * 14, 1024), (7 * 7, 512), (7 * 7, 2048)]
+    for N, C in slabs:
+        f = _lane_fold(N, C)
+        assert _num_chunks(N // f, C * f) is not None, (N, C)
+
+
 def test_indivisible_groups_raise():
     with pytest.raises(ValueError, match="divisible"):
         group_norm(jnp.zeros((1, 4, 4, 66)), jnp.ones(66), jnp.zeros(66),
