@@ -323,6 +323,23 @@ def worker_major_index(
             f"window*batch_size = {per_worker_round}; shrink "
             "batch_size/communication_window or add data")
     rounds_per_epoch = rpw // per_worker_round
+    used = num_workers * rounds_per_epoch * per_worker_round
+    if used < num_rows:
+        import warnings
+
+        remainder = num_rows - rpw * num_workers
+        truncated = num_rows - remainder - used
+        warnings.warn(
+            f"sharded plan uses {used} of {num_rows} rows per epoch "
+            f"({num_rows - used} dropped: {remainder} to the worker "
+            f"remainder num_rows % num_workers, {truncated} to round "
+            f"truncation — each worker's {rpw}-row partition fits "
+            f"{rounds_per_epoch} full rounds of window*batch_size="
+            f"{per_worker_round}). With shuffle=True different rows are "
+            "dropped each epoch; resize batch_size/communication_window to "
+            "change the fit.",
+            stacklevel=2,
+        )
     rng = np.random.default_rng(seed)
     epochs = []
     for _ in range(num_epoch):
