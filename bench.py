@@ -96,32 +96,52 @@ def _prior_values() -> dict[str, float]:
 
 
 def _time_steps(step_once, warmup: int, timed: int, reps: int = None):
-    """Shared timing protocol: warmup, device_get fence (block_until_ready can
-    return early on the tunneled backend — fetching a value cannot), best-of-2
-    repetitions on TPU against tunnel-latency wander (``reps`` overrides; the
-    long-running configs use 1 to keep the whole bench inside the driver's
-    budget — their longer timed loops average the wander instead). Returns
-    best elapsed seconds for ``timed`` calls of ``step_once(i) -> fence``."""
+    """Shared timing protocol: warmup, then ``reps`` independent repetitions
+    of the ``timed``-call loop, each fenced by device_get (block_until_ready
+    can return early on the tunneled backend — fetching a value cannot).
+    Returns the per-rep elapsed seconds list. Round-4 protocol change: the
+    old best-of-2 could not tell a regression from tunnel-latency wander
+    (±20-30% measured; r3's ResNet "regression" was a coin flip) — callers
+    now take a TRIMMED MEDIAN over >=5 reps and record the dispersion."""
     import jax
 
     for i in range(warmup):
         fence = step_once(i)
     jax.device_get(fence)
-    best = float("inf")
     if reps is None:
-        reps = 2 if jax.default_backend() == "tpu" else 1
+        reps = 5 if jax.default_backend() == "tpu" else 1
+    times = []
     for _rep in range(reps):
         t0 = time.perf_counter()
         for i in range(timed):
             fence = step_once(i)
         jax.device_get(fence)
-        best = min(best, time.perf_counter() - t0)
-    return best
+        times.append(time.perf_counter() - t0)
+    return times
+
+
+def _throughput_stats(times, units_per_rep: float) -> dict:
+    """Trimmed-median throughput + dispersion from per-rep elapsed seconds.
+
+    ``value`` is the median of the reps with the single best and worst
+    dropped (n >= 5) — robust to one tunnel-latency outlier in either
+    direction; p10/p90 are over ALL reps so the record keeps the full
+    spread the median is defending against."""
+    tput = sorted(units_per_rep / t for t in times)
+    trimmed = tput[1:-1] if len(tput) >= 5 else tput
+    return {
+        "value": float(np.median(trimmed)),
+        "p50": round(float(np.median(tput)), 1),
+        "p10": round(float(np.percentile(tput, 10)), 1),
+        "p90": round(float(np.percentile(tput, 90)), 1),
+        "reps": len(tput),
+    }
 
 
 def _bench_engine(engine, plan, warmup: int, timed: int, rounds_per_program=1,
                   reps: int = None):
-    """Time `timed` fold rounds of an Async/Sync engine; returns elapsed seconds.
+    """Time `timed` fold rounds of an Async/Sync engine; returns the per-rep
+    elapsed-seconds list (each normalized to ``timed`` rounds).
 
     ``rounds_per_program`` dispatches blocks of rounds as one XLA program
     (``engine.multi_round_fn``) — semantics-preserving, and necessary here:
@@ -193,8 +213,10 @@ def _bench_engine(engine, plan, warmup: int, timed: int, rounds_per_program=1,
         return loss
 
     n_timed = max(1, timed // R)
-    best = _time_steps(one, max(1, warmup // R), n_timed, reps=reps)
-    return best / (n_timed * R) * timed
+    times = _time_steps(one, max(1, warmup // R), n_timed, reps=reps)
+    # Normalize each rep to ``timed`` rounds so callers see per-rep elapsed
+    # for the same notional work regardless of the blocked-program sizing.
+    return [t / (n_timed * R) * timed for t in times]
 
 
 def _measure(name, model_fn, discipline, batch_size, window, sample_shape,
@@ -247,12 +269,13 @@ def _measure(name, model_fn, discipline, batch_size, window, sample_shape,
         engine = AsyncEngine(model, optimizer, "sparse_categorical_crossentropy",
                              fold, mesh, window=window, learning_rate=0.01,
                              compute_dtype="bfloat16")
-    elapsed = _bench_engine(engine, plan, warmup, timed,
-                            rounds_per_program=rounds_per_program, reps=reps)
+    times = _bench_engine(engine, plan, warmup, timed,
+                          rounds_per_program=rounds_per_program, reps=reps)
     samples = timed * workers * window * batch_size
     # per chip IN USE (== all visible chips for the standard configs; the
     # scaling sweep pins smaller worker counts)
-    sps_chip = samples / elapsed / workers
+    stats = _throughput_stats(times, samples / workers)
+    sps_chip = stats["value"]
     tflops = None
     mfu = None
     # Off-TPU the models may be swapped for tiny stand-ins (see resnet50_sync)
@@ -268,6 +291,8 @@ def _measure(name, model_fn, discipline, batch_size, window, sample_shape,
         "metric": f"{name}_samples_per_sec_per_chip",
         "value": round(sps_chip, 1),
         "unit": "samples/s/chip",
+        "p50": stats["p50"], "p10": stats["p10"], "p90": stats["p90"],
+        "reps": stats["reps"],
         "achieved_tflops_per_chip": round(tflops, 2) if tflops else None,
         "mfu_vs_bf16_peak": round(mfu, 4) if mfu else None,
     }
@@ -275,7 +300,7 @@ def _measure(name, model_fn, discipline, batch_size, window, sample_shape,
 
 def _measure_spmd_transformer(name, *, num_layers, d_model, num_heads, d_ff,
                               vocab, seq_len, batch, timed=12, warmup=2,
-                              reps=1):
+                              reps=None):
     """Flagship config: TransformerLM with the Pallas flash-attention kernel,
     single-chip slice (the multi-chip dp x sp x tp path is exercised by
     __graft_entry__.dryrun_multichip with ring attention; the Mosaic flash
@@ -332,10 +357,13 @@ def _measure_spmd_transformer(name, *, num_layers, d_model, num_heads, d_ff,
         carry["p"], carry["o"], loss = step(carry["p"], carry["o"], x, y)
         return loss
 
-    best = _time_steps(one, warmup, timed, reps=reps)
-    tokens_per_s = timed * batch * seq_len / best
+    times = _time_steps(one, warmup, timed, reps=reps)
+    stats = _throughput_stats(times, timed * batch * seq_len)
+    tokens_per_s = stats["value"]
     rec = {"metric": f"{name}_tokens_per_sec_per_chip",
-           "value": round(tokens_per_s, 1), "unit": "tokens/s/chip"}
+           "value": round(tokens_per_s, 1), "unit": "tokens/s/chip",
+           "p50": stats["p50"], "p10": stats["p10"], "p90": stats["p90"],
+           "reps": stats["reps"]}
     if on_tpu:
         # analytic train FLOPs/token: 6 x matmul params (fwd 2P + bwd 4P;
         # embedding lookups aren't matmuls) + causal attention scores/values
@@ -484,7 +512,7 @@ def main():
          dict(batch_size=128 if on_tpu else 4, window=2,
               sample_shape=(224, 224, 3) if on_tpu else (32, 32, 3),
               num_classes=1000 if on_tpu else 10,
-              timed=rounds(8), warmup=2, reps=1)),
+              timed=rounds(8), warmup=2)),
     ]
 
     # 6 - beyond-reference flagship: TransformerLM + flash attention.
