@@ -28,6 +28,37 @@ import numpy as np
 from distkeras_tpu.data.dataframe import DataFrame
 
 
+def apply_round_transform(transform, seed: int, r: int, workers, xs, ys):
+    """Training-time row transform, deterministic in ``(seed, round, worker)``.
+
+    ``transform(features[n, ...], labels[n, ...], rng) -> (features, labels)``
+    is called once per worker slice with the slice flattened to rows and an
+    independent ``np.random.Generator`` seeded from the triple — so
+    ``round_local(r, ws)`` equals ``round(r)[ws]`` by construction, and
+    disjoint multi-host staging sees exactly the rows replicated staging
+    would (the property the 2-proc equality tests pin). This is the lazy
+    half of the Spark pipeline the reference chained over its distributed
+    DataFrame: per-epoch randomized augmentation (crop/flip) that ingest-time
+    transforms cannot express. Row count must be preserved; dtype/shape of
+    the rows may change (e.g. uint8 pixels -> normalized float32)."""
+    out_x, out_y = [], []
+    for i, w in enumerate(workers):
+        rng = np.random.default_rng(
+            np.random.SeedSequence((int(seed), int(r), int(w))))
+        lead = xs[i].shape[:2]  # [K, B]
+        n = lead[0] * lead[1]
+        fx, fy = transform(xs[i].reshape((n,) + xs[i].shape[2:]),
+                           ys[i].reshape((n,) + ys[i].shape[2:]), rng)
+        fx, fy = np.asarray(fx), np.asarray(fy)
+        if len(fx) != n or len(fy) != n:
+            raise ValueError(
+                f"transform must preserve row count: got {len(fx)}/{len(fy)} "
+                f"rows for {n} in")
+        out_x.append(fx.reshape(lead + fx.shape[1:]))
+        out_y.append(fy.reshape(lead + fy.shape[1:]))
+    return np.stack(out_x), np.stack(out_y)
+
+
 @dataclasses.dataclass
 class BatchPlan:
     x: np.ndarray  # [n, ...feature dims] — single materialized copy
@@ -37,6 +68,11 @@ class BatchPlan:
     window: int
     batch_size: int
     rows_total: int
+    #: optional training-time ``fn(features, labels, rng)`` applied to every
+    #: staged round (see :func:`apply_round_transform`); seeded per
+    #: (transform_seed, round, worker).
+    transform: object = None
+    transform_seed: int = 0
 
     @property
     def num_rounds(self) -> int:
@@ -63,7 +99,12 @@ class BatchPlan:
         from distkeras_tpu.data.native_loader import gather_rows
 
         idx = self.index[r]
-        return gather_rows(self.x, idx), gather_rows(self.y, idx)
+        xs, ys = gather_rows(self.x, idx), gather_rows(self.y, idx)
+        if self.transform is not None:
+            xs, ys = apply_round_transform(
+                self.transform, self.transform_seed, r,
+                range(self.num_workers), xs, ys)
+        return xs, ys
 
 
 def make_batches(
@@ -76,12 +117,17 @@ def make_batches(
     num_epoch: int = 1,
     shuffle: bool = False,
     seed: int = 0,
+    transform=None,
 ) -> BatchPlan:
     """Lay out ``num_epoch`` passes over ``df`` as fold-round index matrices.
 
     Rows that don't fill a complete round are dropped (the reference likewise
     truncates trailing partial minibatches per partition). With ``shuffle`` each
     epoch gets an independent permutation, so dropped rows differ per epoch.
+
+    ``transform``: optional training-time ``fn(features, labels, rng)`` row
+    transform applied to every staged round, deterministically seeded per
+    (seed, round, worker) — see :func:`apply_round_transform`.
 
     A :class:`~.shards.ShardedDataFrame` routes to the disk-backed planner
     (``shards.make_sharded_batches``): same trainer call, out-of-core data
@@ -95,7 +141,8 @@ def make_batches(
 
         return make_sharded_batches(
             df, features_col, label_col, batch_size, num_workers,
-            window=window, num_epoch=num_epoch, shuffle=shuffle, seed=seed)
+            window=window, num_epoch=num_epoch, shuffle=shuffle, seed=seed,
+            transform=transform)
     x = np.asarray(df[features_col])
     y = np.asarray(df[label_col])
     n = len(x)
@@ -126,4 +173,6 @@ def make_batches(
         window=window,
         batch_size=batch_size,
         rows_total=n * num_epoch,
+        transform=transform,
+        transform_seed=seed,
     )

@@ -44,24 +44,96 @@ import numpy as np
 from distkeras_tpu.data.native_loader import gather_rows
 
 _MANIFEST = "manifest.json"
+_PART_MANIFEST = "manifest.part.json"
 
 
 def _shard_file(shard: int, col: str) -> str:
     return f"shard-{shard:05d}.{col}.npy"
 
 
+def merge_manifests(path: str) -> dict:
+    """Splice ``part-*/`` writer outputs into one readable store.
+
+    Run ONCE after every :class:`ShardWriter` with ``part=k`` closed (e.g. by
+    process 0 behind a barrier): renames each part's shard files into the
+    global shard sequence in part-id order (same-filesystem renames — no data
+    is copied), validates that every part wrote the same column schema, and
+    publishes the root manifest atomically. Reads from the merged store are
+    byte-identical to a single writer fed the concatenated row stream with
+    per-part shard boundaries."""
+    parts = sorted(d for d in os.listdir(path)
+                   if d.startswith("part-")
+                   and os.path.isdir(os.path.join(path, d)))
+    if not parts:
+        raise FileNotFoundError(f"no part-*/ writer directories under {path}")
+    columns: Optional[dict] = None
+    shard_rows: list[int] = []
+    g = 0
+    for d in parts:
+        pdir = os.path.join(path, d)
+        with open(os.path.join(pdir, _PART_MANIFEST)) as f:
+            pm = json.load(f)
+        if not pm["shard_rows"]:
+            os.remove(os.path.join(pdir, _PART_MANIFEST))
+            os.rmdir(pdir)
+            continue  # a writer that saw zero rows contributes nothing
+        if columns is None:
+            columns = pm["columns"]
+        elif pm["columns"] != columns:
+            raise ValueError(
+                f"part {d} wrote a different column schema: {pm['columns']} "
+                f"vs {columns}")
+        for i, rows in enumerate(pm["shard_rows"]):
+            for col in columns:
+                os.replace(os.path.join(pdir, _shard_file(i, col)),
+                           os.path.join(path, _shard_file(g, col)))
+            shard_rows.append(int(rows))
+            g += 1
+        os.remove(os.path.join(pdir, _PART_MANIFEST))
+        os.rmdir(pdir)
+    if columns is None:
+        raise ValueError(f"every part under {path} was empty")
+    offsets = np.concatenate([[0], np.cumsum(shard_rows)]).tolist()
+    manifest = {
+        "version": 1,
+        "num_rows": int(offsets[-1]),
+        "columns": columns,
+        "shard_rows": shard_rows,
+        "shard_offsets": [int(o) for o in offsets[:-1]],
+    }
+    tmp = os.path.join(path, ".manifest.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, os.path.join(path, _MANIFEST))
+    return manifest
+
+
 class ShardWriter:
     """Streaming writer: append row chunks, emit ``rows_per_shard``-row shard
     files. Nothing is ever held beyond one shard's buffer, so a 100 GB dataset
     can be written from a generator with bounded RAM (the ingest-side half of
-    the out-of-core contract)."""
+    the out-of-core contract).
 
-    def __init__(self, path: str, rows_per_shard: int):
+    **Distributed ingest** (the Spark-executor-parallel write): pass
+    ``part=k`` on writer ``k`` of N — each writer streams its own row range
+    into an isolated ``part-000NN/`` subdirectory (no cross-writer
+    coordination, any filesystem), then ONE caller runs
+    :func:`merge_manifests` after every writer closed, which splices the
+    parts into the global shard sequence (cheap same-filesystem renames)
+    and publishes the root manifest. Part order = part id, so the merged
+    row order is writer 0's rows, then writer 1's, ...
+    """
+
+    def __init__(self, path: str, rows_per_shard: int,
+                 part: Optional[int] = None):
         if rows_per_shard < 1:
             raise ValueError(f"rows_per_shard must be >= 1, got {rows_per_shard}")
         self.path = path
+        self.part = part
+        self._dir = (path if part is None
+                     else os.path.join(path, f"part-{int(part):05d}"))
         self.rows_per_shard = int(rows_per_shard)
-        os.makedirs(path, exist_ok=True)
+        os.makedirs(self._dir, exist_ok=True)
         self._buf: dict[str, list[np.ndarray]] = {}
         self._buffered = 0
         self._shards: list[int] = []  # rows per emitted shard
@@ -99,7 +171,7 @@ class ShardWriter:
         shard = len(self._shards)
         for k, chunks in self._buf.items():
             cat = np.concatenate(chunks) if len(chunks) > 1 else chunks[0]
-            np.save(os.path.join(self.path, _shard_file(shard, k)), cat[:rows])
+            np.save(os.path.join(self._dir, _shard_file(shard, k)), cat[:rows])
             self._buf[k] = [cat[rows:]] if rows < len(cat) else []
         self._shards.append(rows)
         self._buffered -= rows
@@ -116,7 +188,11 @@ class ShardWriter:
             self.close()
 
     def close(self) -> dict:
-        """Flush the tail shard and write the manifest; returns the manifest."""
+        """Flush the tail shard and write the manifest; returns the manifest.
+
+        A ``part=k`` writer publishes a PART manifest inside its own
+        subdirectory instead of the root one — the store only becomes
+        readable once :func:`merge_manifests` splices every part."""
         if self._closed:
             raise RuntimeError("ShardWriter already closed")
         if self._buffered:
@@ -130,7 +206,8 @@ class ShardWriter:
             "shard_rows": [int(r) for r in self._shards],
             "shard_offsets": [int(o) for o in offsets[:-1]],
         }
-        with open(os.path.join(self.path, _MANIFEST), "w") as f:
+        name = _MANIFEST if self.part is None else _PART_MANIFEST
+        with open(os.path.join(self._dir, name), "w") as f:
             json.dump(manifest, f)
         return manifest
 
@@ -283,9 +360,11 @@ class ShardedDataFrame:
                     "split", "random_split", "randomSplit", "iter_rows"}:
             raise AttributeError(
                 f"ShardedDataFrame does not materialize rows; {name!r} is an "
-                "in-RAM DataFrame op. Apply transforms at ingest time "
-                "(ShardWriter) — training-time shuffling is the planner's job "
-                "(make_batches(..., shuffle=True) permutes within partitions).")
+                "in-RAM DataFrame op. Apply one-shot transforms at ingest "
+                "time (ShardWriter), per-round transforms at training time "
+                "(Trainer(transform=fn) / make_batches(transform=fn)) — "
+                "shuffling is the planner's job (make_batches(..., "
+                "shuffle=True) permutes within partitions).")
         raise AttributeError(name)
 
 
@@ -371,6 +450,14 @@ class ShardedBatchPlan:
     window: int
     batch_size: int
     rows_total: int
+    #: optional training-time ``fn(features, labels, rng)`` (see
+    #: ``batching.apply_round_transform``): applied per worker slice with a
+    #: (transform_seed, round, worker)-seeded rng, so disjoint per-host
+    #: staging (round_local) and full staging (round) transform identically —
+    #: the lazy Spark-pipeline half the store's ingest-time-only transforms
+    #: could not express (per-epoch randomized augmentation).
+    transform: object = None
+    transform_seed: int = 0
 
     is_local = True
 
@@ -393,17 +480,24 @@ class ShardedBatchPlan:
     def round(self, r: int) -> tuple[np.ndarray, np.ndarray]:
         """Full ``[W, K, B, ...]`` gather — valid only where every shard is
         present (single host, or a shared filesystem)."""
-        idx = self.index[r]
-        return (self.store.gather(self.features_col, idx),
-                self.store.gather(self.label_col, idx))
+        return self.round_local(r, range(self.num_workers))
 
     def round_local(self, r: int, workers: Sequence[int]
                     ) -> tuple[np.ndarray, np.ndarray]:
         """Rows for the given workers only: ``[len(workers), K, B, ...]``.
         Touches only the shards overlapping those workers' partitions."""
-        idx = self.index[r][np.asarray(list(workers), np.int64)]
-        return (self.store.gather(self.features_col, idx),
-                self.store.gather(self.label_col, idx))
+        workers = list(workers)
+        idx = self.index[r][np.asarray(workers, np.int64)]
+        xs = self.store.gather(self.features_col, idx)
+        ys = self.store.gather(self.label_col, idx)
+        if self.transform is not None:
+            from distkeras_tpu.data.batching import apply_round_transform
+
+            # Seeded by GLOBAL worker id: a host staging workers [2, 3]
+            # transforms them exactly as a full-store host would.
+            xs, ys = apply_round_transform(
+                self.transform, self.transform_seed, r, workers, xs, ys)
+        return xs, ys
 
     def local_shards(self, workers: Sequence[int]) -> list[int]:
         """Shard ids a process hosting ``workers`` needs on local disk."""
@@ -425,6 +519,7 @@ def make_sharded_batches(
     num_epoch: int = 1,
     shuffle: bool = False,
     seed: int = 0,
+    transform=None,
 ) -> ShardedBatchPlan:
     """Plan ``num_epoch`` passes over a :class:`ShardedDataFrame` /
     :class:`ShardStore` (the disk-backed twin of ``batching.make_batches``)."""
@@ -444,4 +539,6 @@ def make_sharded_batches(
         window=window,
         batch_size=batch_size,
         rows_total=store.count() * num_epoch,
+        transform=transform,
+        transform_seed=seed,
     )

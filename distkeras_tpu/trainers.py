@@ -96,6 +96,7 @@ class Trainer:
         rounds_per_program: Union[int, str] = 1,
         on_round=None,
         grad_accum: int = 1,
+        transform=None,
         **kwargs,
     ):
         legacy = {k: kwargs.pop(k) for k in list(kwargs) if k in _LEGACY_SOCKET_KWARGS}
@@ -155,6 +156,13 @@ class Trainer:
         self.grad_accum = int(grad_accum)
         if self.grad_accum < 1:
             raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+        #: optional training-time row transform ``fn(features, labels, rng)
+        #: -> (features, labels)`` applied to every staged round
+        #: (deterministic per (seed, round, worker) — the lazy Spark-pipeline
+        #: half: per-epoch randomized augmentation, train-time normalization;
+        #: works for in-RAM and sharded dataframes alike). See
+        #: ``data.batching.apply_round_transform``.
+        self.transform = transform
         self.history: np.ndarray | None = None
         self.worker_histories: np.ndarray | None = None
         self.training_time: float = 0.0
@@ -412,7 +420,7 @@ class SingleTrainer(Trainer):
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,
             num_workers=1, window=self.steps_per_program, num_epoch=self.num_epoch,
-            shuffle=shuffle, seed=self.seed,
+            shuffle=shuffle, seed=self.seed, transform=self.transform,
         )
         state = self._execute(engine, plan)
         self.record_training_stop()
@@ -464,7 +472,7 @@ class SynchronousDistributedTrainer(DistributedTrainer):
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,
             num_workers=engine.num_workers, window=self.steps_per_program,
-            num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed,
+            num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed, transform=self.transform,
         )
         state = self._execute(engine, plan)
         self.record_training_stop()
@@ -495,7 +503,7 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,
             num_workers=engine.num_workers, window=self.communication_window,
-            num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed,
+            num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed, transform=self.transform,
         )
         return self._execute(engine, plan)
 
@@ -716,7 +724,7 @@ class ParallelTrainer(Trainer):
         plan = make_batches(
             dataframe, self.features_col, self.label_col, per_worker_batch,
             num_workers=plan_workers, window=self.steps_per_program,
-            num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed,
+            num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed, transform=self.transform,
         )
         state = self._execute(engine, plan)
         self.record_training_stop()
@@ -761,7 +769,7 @@ class AveragingTrainer(DistributedTrainer):
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,
             num_workers=engine.num_workers, window=self.communication_window,
-            num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed,
+            num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed, transform=self.transform,
         )
         state = self._execute(engine, plan)
         averaged = jax.tree.map(lambda a: jnp.mean(a, axis=0), state.locals_)
@@ -792,7 +800,7 @@ class EnsembleTrainer(DistributedTrainer):
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,
             num_workers=engine.num_workers, window=self.communication_window,
-            num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed,
+            num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed, transform=self.transform,
         )
         state = self._execute(engine, plan)
         self.record_training_stop()

@@ -76,6 +76,25 @@ def build_virtual_store(root: str, virtual_gb: float, image_hw: int,
         }, f)
 
 
+def augment(feats: np.ndarray, labels: np.ndarray, rng: np.random.Generator):
+    """Standard ImageNet training augmentation as a training-time transform
+    (``Trainer(transform=...)``): per-image random horizontal flip + random
+    crop from 4-pixel-padded. Runs host-side during staging, deterministic in
+    (seed, round, worker) — out-of-core stores get per-epoch randomized
+    augmentation that ingest-time transforms cannot express."""
+    n, h, w, _ = feats.shape
+    out = np.where(
+        (rng.random(n) < 0.5)[:, None, None, None], feats[:, :, ::-1], feats)
+    pad = 4
+    padded = np.pad(out, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                    mode="reflect")
+    ys = rng.integers(0, 2 * pad + 1, size=n)
+    xs = rng.integers(0, 2 * pad + 1, size=n)
+    out = np.stack([padded[i, ys[i]:ys[i] + h, xs[i]:xs[i] + w]
+                    for i in range(n)])
+    return out, labels
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--virtual-gb", type=float, default=0.05,
@@ -110,10 +129,11 @@ def main():
     trainer = dk.SynchronousDistributedTrainer(
         model, loss="sparse_categorical_crossentropy", num_workers=workers,
         batch_size=args.batch_size, num_epoch=1, learning_rate=0.01,
-        steps_per_program=2, compute_dtype="bfloat16",
+        steps_per_program=2, compute_dtype="bfloat16", transform=augment,
         on_round=lambda r, loss: print(f"round {r}: loss {float(loss):.4f}"))
-    print(f"training ResNet sync-DP on {workers} worker(s); one epoch "
-          "streams the full logical dataset from disk ...")
+    print(f"training ResNet sync-DP on {workers} worker(s) with random "
+          "crop/flip augmentation; one epoch streams the full logical "
+          "dataset from disk ...")
     trainer.train(sdf)
     h = trainer.get_history()
     print(f"done: {len(h)} rounds, loss {h[0]:.4f} -> {h[-1]:.4f}")

@@ -1,0 +1,94 @@
+"""Worker script for the 2-process distributed-ingest + sharded-predict test.
+
+Each process (a) streams ITS OWN row range into the store via a ``part=k``
+:class:`ShardWriter` — the Spark-executor-parallel write — after which process
+0 splices the parts with ``merge_manifests``; then (b) runs the multi-process
+out-of-core predict: disjoint shard ranges, process-local forward, manifest
+committed by every process behind a global barrier. Results land in
+``$DK_OUT/proc<i>.json`` for the parent test to cross-check against the
+single-writer + single-process reference.
+
+Run only via ``tests/test_multihost.py``.
+"""
+
+import json
+import os
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main() -> None:
+    from jax.experimental import multihost_utils
+
+    from distkeras_tpu.data.shards import (
+        ShardWriter,
+        ShardedDataFrame,
+        merge_manifests,
+    )
+    from distkeras_tpu.models import Model
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.predictors import ClassPredictor
+    from distkeras_tpu.runtime.mesh import distributed_initialize
+
+    distributed_initialize(
+        coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+        num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+        process_id=int(os.environ["JAX_PROCESS_ID"]),
+    )
+    pid, nproc = jax.process_index(), jax.process_count()
+
+    # The same deterministic blobs the parent test generates (seed 0).
+    rng = np.random.default_rng(0)
+    n, d, c = 512, 4, 3
+    centers = rng.normal(scale=4.0, size=(c, d))
+    y = rng.integers(0, c, size=n).astype(np.int32)
+    x = (centers[y] + rng.normal(scale=0.5, size=(n, d))).astype(np.float32)
+
+    # (a) Distributed ingest: process p streams rows [p*n/P, (p+1)*n/P) in
+    # ragged 50-row chunks (exercises cross-chunk shard buffering).
+    store_dir = os.path.join(os.environ["DK_OUT"], "store")
+    lo, hi = pid * n // nproc, (pid + 1) * n // nproc
+    with ShardWriter(store_dir, rows_per_shard=64, part=pid) as w:
+        for s in range(lo, hi, 50):
+            e = min(s + 50, hi)
+            w.append(features=x[s:e], label=y[s:e])
+    multihost_utils.sync_global_devices("dk_test_ingest_done")
+    if pid == 0:
+        merge_manifests(store_dir)
+    multihost_utils.sync_global_devices("dk_test_merged")
+
+    # (b) Multi-process sharded predict over the merged store.
+    sdf = ShardedDataFrame(store_dir)
+    model = Model.build(MLP(hidden=(16,), num_outputs=c),
+                        np.zeros((1, d), np.float32), seed=0)
+    out = ClassPredictor(model, output_col="pred", chunk_size=64).predict(sdf)
+
+    # Predict AGAIN into the same column: exercises the agreed fresh
+    # versioned physical name across processes.
+    out = ClassPredictor(model, output_col="pred", chunk_size=64).predict(out)
+
+    preds = np.concatenate(
+        [ch["pred"] for ch in out.iter_column_chunks("pred")])
+    feats = np.concatenate(
+        [ch["features"] for ch in out.iter_column_chunks("features")])
+    res = {
+        "process": pid,
+        "num_rows": int(sdf.count()),
+        "shard_rows": list(out.store.manifest["shard_rows"]),
+        "pred_file": out.store.columns["pred"].get("file", "pred"),
+        "preds": [int(v) for v in preds],
+        "features_ok": bool(np.array_equal(feats, x)),
+    }
+    with open(os.path.join(os.environ["DK_OUT"], f"proc{pid}.json"), "w") as f:
+        json.dump(res, f)
+    print(f"proc {pid}: ingest+predict ok, {len(preds)} predictions")
+
+
+if __name__ == "__main__":
+    main()

@@ -322,6 +322,78 @@ def test_parallel_trainer_disjoint_shards(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_ingest_and_sharded_predict(tmp_path):
+    """The two out-of-core corners the r3 verdict flagged as guarded-not-
+    closed: (a) DISTRIBUTED INGEST — two part-ShardWriters + merge_manifests
+    produce a store whose reads are byte-identical to one writer fed the same
+    stream; (b) MULTI-PROCESS SHARDED PREDICT — disjoint shard ranges with a
+    process-local forward equal the single-process predict, including a
+    second predict over the same column (agreed versioned physical name)."""
+    import numpy as np
+
+    from distkeras_tpu.data.shards import (
+        ShardStore, ShardedDataFrame, write_shards)
+    from distkeras_tpu.models import Model
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.predictors import ClassPredictor
+
+    card_worker = os.path.join(os.path.dirname(__file__),
+                               "multihost_predict_worker.py")
+    card = Punchcard(
+        job_name="pytest-2proc-predict",
+        script=card_worker,
+        hosts=["localhost"] * 2,
+        coordinator_port=_free_port(),
+        env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "KERAS_BACKEND": "jax",
+            "DK_OUT": str(tmp_path),
+            "PYTHONPATH": _REPO,
+        },
+    )
+    job = Job(card)
+    job.launch(dry_run=False)
+    rcs = job.supervise(timeout=600)
+    assert rcs == [0, 0], f"worker processes failed: rcs={rcs}"
+    results = _read_results(tmp_path)
+
+    # Single-writer + single-process reference on identical data.
+    rng = np.random.default_rng(0)
+    n, d, c = 512, 4, 3
+    centers = rng.normal(scale=4.0, size=(c, d))
+    y = rng.integers(0, c, size=n).astype(np.int32)
+    x = (centers[y] + rng.normal(scale=0.5, size=(n, d))).astype(np.float32)
+    ref_store = tmp_path / "ref_store"
+    write_shards(ref_store, {"features": x, "label": y}, rows_per_shard=64)
+    model = Model.build(MLP(hidden=(16,), num_outputs=c),
+                        np.zeros((1, d), np.float32), seed=0)
+    ref = ClassPredictor(model, output_col="pred", chunk_size=64).predict(
+        ShardedDataFrame(ref_store))
+    ref_preds = np.concatenate(
+        [ch["pred"] for ch in ref.iter_column_chunks("pred")])
+
+    # (a) merged two-writer store == one-writer store, byte-identical reads.
+    merged = ShardStore.open(str(tmp_path / "store"))
+    assert merged.manifest["shard_rows"] == ref.store.manifest["shard_rows"]
+    ids = np.arange(n)
+    np.testing.assert_array_equal(merged.gather("features", ids),
+                                  ref.store.gather("features", ids))
+    np.testing.assert_array_equal(merged.gather("label", ids),
+                                  ref.store.gather("label", ids))
+    assert not any(f.startswith("part-") for f in os.listdir(tmp_path / "store"))
+
+    # (b) multi-process predict over disjoint shard ranges == single-process.
+    for r in results:
+        assert r["num_rows"] == n and r["features_ok"], r
+        assert r["preds"] == [int(v) for v in ref_preds], (
+            "multi-process sharded predict diverged from single-process")
+        # Second predict re-versioned the column's physical files.
+        assert r["pred_file"] != "pred"
+    assert results[0]["pred_file"] == results[1]["pred_file"]  # agreed name
+
+
+@pytest.mark.slow
 def test_fault_injection_checkpoint_recovery(tmp_path):
     """Kill one host mid-training (hard abort, no cleanup — a preempted pod
     host), then relaunch the job with resume: the recovered run must finish
