@@ -45,6 +45,59 @@ def allreduce_seconds(model_bytes: float, n_chips: int,
 
 
 @dataclasses.dataclass
+class SyncStepScalingModel:
+    """Per-STEP sync-DP scaling — BASELINE config #5's actual gate (ResNet-50
+    on a v5e-256 pod, scaling efficiency 1 -> 256 chips).
+
+    Unlike the window-K fold (:class:`FoldScalingModel`), synchronous DP
+    all-reduces the full f32 gradient EVERY optimizer step — no window
+    amortization — so the ratio is much harsher: ~100 MB of ResNet-50 grads
+    against one step's compute. Past ``chips_per_slice`` the reduction goes
+    hierarchical (multislice): intra-slice reduce-scatter over ICI leaves
+    each chip ``grad_bytes/intra`` of reduced shards, the cross-slice
+    exchange rides each HOST's DCN NIC (which carries its
+    ``chips_per_host`` chips' shares), then the intra-slice all-gather
+    completes — the standard v5e multislice pattern, with zero
+    compute/comm overlap assumed throughout (conservative).
+
+    Levers the model exposes (both are real knobs in this repo):
+
+    * ``grad_bytes``: bf16 gradient all-reduce halves it
+      (``ops/precision.py`` casts; psum in bf16);
+    * ``grad_accum``: A micro-batches per optimizer step multiply the
+      compute a single all-reduce amortizes (``Trainer(grad_accum=A)``).
+    """
+
+    step_seconds: float  # measured single-chip optimizer-step time
+    grad_bytes: float  # bytes all-reduced per step (f32 grads = 4 x params)
+    ici_bytes_per_s: float = ICI_LINK_BYTES_PER_S
+    dcn_bytes_per_s: float = DCN_BYTES_PER_S
+    chips_per_slice: int = 256  # ICI domain; beyond it the hop crosses DCN
+    chips_per_host: int = 8  # v5e: 8 chips share one NIC
+    grad_accum: int = 1
+
+    def comm_seconds(self, n_chips: int) -> float:
+        intra = min(n_chips, self.chips_per_slice)
+        t = allreduce_seconds(self.grad_bytes, intra, self.ici_bytes_per_s)
+        if n_chips > self.chips_per_slice:
+            slices = -(-n_chips // self.chips_per_slice)  # ceil
+            per_host = self.grad_bytes / intra * self.chips_per_host
+            t += (2.0 * per_host * (slices - 1) / slices
+                  / self.dcn_bytes_per_s)
+        return t
+
+    def efficiency(self, n_chips: int) -> float:
+        compute = self.step_seconds * self.grad_accum
+        return compute / (compute + self.comm_seconds(n_chips))
+
+    def curve(self, chips=(1, 2, 4, 8, 16, 32, 64, 128, 256)) -> list[dict]:
+        return [{"num_chips": n,
+                 "comm_ms": round(self.comm_seconds(n) * 1e3, 4),
+                 "efficiency": round(self.efficiency(n), 4)}
+                for n in chips]
+
+
+@dataclasses.dataclass
 class FoldScalingModel:
     """Scaling of a window-K collective-fold discipline (AEASGD/ADAG/...).
 
