@@ -553,9 +553,11 @@ def main():
          dict(batch_size=1024 if on_tpu else 64, window=8, sample_shape=(784,),
               num_classes=10, timed=rounds(64), optimizer="adam",
               rounds_per_program="auto")),
-        # 2 — MNIST CNN under ADAG (async adaptive gradients)
+        # 2 — MNIST CNN under ADAG (async adaptive gradients). B=2048: the
+        # r4 on-chip B-sweep (1024/2048/4096 -> 31.6/35.0/24.6 TF raw step)
+        # puts the knee at 2048; see docs/PERFORMANCE.md.
         ("mnist_cnn_adag", mnist_cnn, "adag",
-         dict(batch_size=1024 if on_tpu else 32, window=8,
+         dict(batch_size=2048 if on_tpu else 32, window=8,
               sample_shape=(28, 28, 1), num_classes=10, timed=rounds(32),
               rounds_per_program="auto")),
         # 3 — NORTH STAR: CIFAR-10 CNN under AEASGD (elastic averaging)
@@ -567,11 +569,14 @@ def main():
         # cell_impl="pallas": the whole recurrence as one Pallas program
         # (weights resident in VMEM across timesteps) — 1.9x over the XLA
         # scan lowering on this chip (ops/pallas/lstm.py).
+        # B=2048 amortizes the recurrence's serial per-step latency (r4
+        # B-sweep: 512/1024/2048/4096 -> 22.4/27.4/34.1/32.6 TF; the kernel's
+        # VMEM cap was raised to admit B>2048 — docs/PERFORMANCE.md).
         ("imdb_lstm_dynsgd",
          lambda: imdb_lstm(vocab_size=20000, embed_dim=64, hidden_size=128,
                            seq_len=200, cell_impl="pallas" if on_tpu else "xla"),
          "dynsgd",
-         dict(batch_size=512 if on_tpu else 8, window=4, sample_shape=(200,),
+         dict(batch_size=2048 if on_tpu else 8, window=4, sample_shape=(200,),
               num_classes=2, timed=rounds(24), int_inputs=True, vocab=20000,
               rounds_per_program="auto")),
         # 5 — ResNet-50 sync DP (BASELINE's pod config, single-chip slice here)

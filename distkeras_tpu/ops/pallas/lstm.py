@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 GATES = ("i", "f", "g", "o")
 
@@ -164,6 +165,18 @@ def _const_spec(shape):
     return pl.BlockSpec(shape, lambda t: (0,) * nd)
 
 
+def _vmem_kw(interpret: bool) -> dict:
+    """Raise the scoped-VMEM cap: the kernel's per-step [B, 4H] gate block
+    tops the default 16 MiB plan past B=2048 (18 MiB at B=4096, H=128),
+    and large batches are the one lever that amortizes the recurrence's
+    serial per-step latency (measured: B 512 -> 2048 lifts MFU 11.4% ->
+    17.3%; see docs/PERFORMANCE.md round-4 LSTM section)."""
+    if interpret:
+        return {}
+    return {"compiler_params": pltpu.CompilerParams(
+        vmem_limit_bytes=96 * 1024 * 1024)}
+
+
 def _run_fwd(wx, wh, b, x_tbe, interpret: bool, stash: bool = True):
     """Forward pass; ``stash=False`` (inference/primal) skips the BPTT
     residual outputs — cs and gates are 5x the HBM write traffic of hs."""
@@ -191,6 +204,7 @@ def _run_fwd(wx, wh, b, x_tbe, interpret: bool, stash: bool = True):
             jax.ShapeDtypeStruct((B, H), f32),
         ],
         interpret=interpret,
+        **_vmem_kw(interpret),
     )(x_tbe, wx, wh, b.reshape(1, -1))
     if stash:
         hs, cs, gates = outs[0], outs[1], outs[2]
@@ -244,6 +258,7 @@ def _lstm_bwd(interpret, res, dhs):
             jax.ShapeDtypeStruct((B, H), f32),
         ],
         interpret=interpret,
+        **_vmem_kw(interpret),
     )(dhs, x_tbe, hs, cs, cs, gates, wx, wh)
     return (dwx.astype(wx.dtype), dwh.astype(wh.dtype),
             db[0].astype(b.dtype), dx)
