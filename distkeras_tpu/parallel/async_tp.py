@@ -1,0 +1,243 @@
+"""Async disciplines x tensor parallelism: each logical worker IS a submesh.
+
+The reference's workers were single-GPU processes, so its async disciplines
+never composed with model parallelism (SURVEY.md §2 parallelism inventory).
+On TPU there is no reason a "worker" must be one chip: this engine runs the
+same five discipline folds over a 2-D ``(data, model)`` mesh — the ``data``
+axis indexes logical workers, and each worker's replica (params, optimizer
+state, forward/backward) is tensor-sharded over ``model`` by the standard
+PartitionSpec rules (``parallel/sharding.py``). AEASGD across 8 workers each
+holding a tp=2 transformer becomes expressible::
+
+    AEASGD(model, num_workers=8, parallel={"model": 2}).train(df)
+
+Mechanics: where :class:`~.engine.AsyncEngine` shard_maps one worker per
+chip and folds with an explicit ``psum``, this engine is pure GSPMD — the
+per-worker state is stacked ``[W, ...]`` and sharded ``P('data', *tp_spec)``,
+the window of local steps runs under ``jax.vmap`` over the worker axis, and
+the fold's cross-worker sum is a plain ``sum(axis=0)`` that XLA lowers to the
+same single all-reduce over ``data`` (while the TP all-reduces ride
+``model``). Discipline semantics are shared verbatim: the engine calls the
+same ``Discipline.commit`` the shard_map engine folds, so worker ids,
+staleness rotation, and elastic moves are identical — the flat-mesh and
+tp-mesh runs of a TP-invariant model agree to float tolerance
+(``tests/test_async_tp.py``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distkeras_tpu.parallel.engine import (
+    AsyncEngine,
+    EngineState,
+    _stack_for_workers,
+    put_worker_local,
+)
+from distkeras_tpu.parallel.sharding import mirror_tree_specs, param_path_specs
+from distkeras_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, put_global
+
+
+class AsyncTPEngine(AsyncEngine):
+    """A :class:`Discipline` over a ``(data, model)`` mesh: ``data`` indexes
+    workers, ``model`` tensor-shards every worker's replica under ``rules``.
+    """
+
+    def __init__(self, model, optimizer, loss, discipline, mesh, window,
+                 rules=(), **kwargs):
+        if kwargs.get("workers_per_chip", 1) != 1:
+            raise ValueError(
+                "AsyncTPEngine does not multiplex workers per chip: a "
+                "worker already spans a tp submesh. Drop workers_per_chip "
+                "or use the flat AsyncEngine.")
+        if MODEL_AXIS not in mesh.axis_names:
+            raise ValueError(
+                f"AsyncTPEngine needs a '{MODEL_AXIS}' mesh axis, got "
+                f"{mesh.axis_names}; use hybrid_mesh({{'data': W, "
+                "'model': tp}})")
+        # Same guards as GSPMDEngine: a pure-GSPMD engine binds no named
+        # mesh axes, so Mosaic custom calls and named-axis collectives
+        # cannot partition/engage under it.
+        if getattr(model.module, "attn_impl", None) == "flash":
+            raise ValueError(
+                "AsyncTPEngine cannot host attn_impl='flash': the Mosaic "
+                "kernel is not GSPMD-auto-partitionable. Use "
+                "attn_impl='dense' (XLA fuses the attention) for the "
+                "async-TP composition.")
+        if getattr(model.module, "seq_axis", None) is not None:
+            raise ValueError(
+                "AsyncTPEngine cannot host sequence parallelism "
+                "(seq_axis set): ring collectives need a shard_map-bound "
+                "axis. Use SPMDEngine/ParallelTrainer for sp.")
+        self.rules = tuple(rules)
+        super().__init__(model, optimizer, loss, discipline, mesh, window,
+                         **kwargs)
+
+    # -- sharding layouts ----------------------------------------------------
+    def _restrict(self, spec: P) -> P:
+        names = self.mesh.axis_names
+
+        def keep(a):
+            if a is None:
+                return None
+            if isinstance(a, (tuple, list)):
+                kept = tuple(x for x in a if x in names)
+                return kept or None
+            return a if a in names else None
+
+        return P(*(keep(a) for a in spec))
+
+    def _param_specs(self):
+        return param_path_specs(self.model.params, self.rules)
+
+    def _center_shardings(self):
+        return jax.tree.map(
+            lambda s: NamedSharding(self.mesh, self._restrict(s)),
+            self._param_specs(), is_leaf=lambda x: isinstance(x, P))
+
+    def _stacked_shardings(self):
+        return jax.tree.map(
+            lambda s: NamedSharding(self.mesh,
+                                    P(DATA_AXIS, *self._restrict(s))),
+            self._param_specs(), is_leaf=lambda x: isinstance(x, P))
+
+    # -- the round program ---------------------------------------------------
+    def _build_round_fn(self):
+        disc = self.discipline
+        window = self.window
+        W = self.num_workers
+        local_loop = self._local_loop
+        center_sh = self._center_shardings()
+        stacked_sh = self._stacked_shardings()
+
+        def wsc(tree, sh):
+            return jax.tree.map(jax.lax.with_sharding_constraint, tree, sh)
+
+        def round_fn(state: EngineState, xs, ys):
+            center, locals_, opt_state = (state.center, state.locals_,
+                                          state.opt_state)
+            fold_state, rng, model_state = (state.fold_state, state.rng,
+                                            state.model_state)
+            wids = jnp.arange(W)
+            start = (_stack_for_workers(center, W) if disc.pulls_center
+                     else locals_)
+            worker_rngs = jax.vmap(lambda w: jax.random.fold_in(rng, w))(wids)
+            new_local, new_opt, mstate, losses = jax.vmap(local_loop)(
+                start, opt_state, xs, ys, worker_rngs, model_state)
+            if disc.syncs_state:
+                # Cross-worker mean of mutable stats (same semantics as the
+                # shard_map engine's pmean over the worker axis).
+                mstate = jax.tree.map(
+                    lambda a: jnp.broadcast_to(
+                        a.mean(axis=0, keepdims=True), a.shape), mstate)
+            if disc.communicates:
+                commits, new_local = jax.vmap(
+                    lambda loc, w: disc.commit(
+                        center, loc, fold_state, worker_id=w, window=window,
+                        num_workers=W))(new_local, wids)
+                # GSPMD lowers this to ONE all-reduce over `data` — the
+                # exact psum of the shard_map fold.
+                total = jax.tree.map(lambda a: a.sum(axis=0), commits)
+                new_center = jax.tree.map(jnp.add, center, total)
+                if disc.pulls_center:
+                    new_local = _stack_for_workers(new_center, W)
+            else:
+                new_center = center
+            # Pin the two big tensors' layouts so GSPMD cannot drift them
+            # between rounds (donation reuses the input buffers).
+            new_center = wsc(new_center, center_sh)
+            new_local = wsc(new_local, stacked_sh)
+            loss = jnp.mean(losses, axis=tuple(range(1, losses.ndim)))  # [W]
+            next_rng = jax.random.split(rng, 1)[0]
+            new_state = EngineState(new_center, new_local, new_opt,
+                                    disc.advance(fold_state), next_rng,
+                                    mstate)
+            return new_state, loss
+
+        self._round_core = round_fn
+        return jax.jit(round_fn, donate_argnums=(0,))
+
+    # -- state ---------------------------------------------------------------
+    def init_state(self) -> EngineState:
+        W = self.num_workers
+        center = jax.tree.map(lambda a: np.array(a), self.model.params)
+        if self.per_worker_init:
+            per = [self.model.reinit_params(self.seed * 1009 + 1 + i)
+                   for i in range(W)]
+            locals_ = jax.tree.map(lambda *xs: jnp.stack(xs), *per)
+        else:
+            locals_ = _stack_for_workers(
+                jax.tree.map(jnp.asarray, center), W)
+        opt_state = _stack_for_workers(self.tx.init(center), W)
+        fold_state = self.discipline.init_state(center)
+        rng = jax.random.key(self.seed)
+
+        center_sh = self._center_shardings()
+        stacked_sh = self._stacked_shardings()
+        rep = NamedSharding(self.mesh, P())
+        wshard = NamedSharding(self.mesh, P(DATA_AXIS))
+        # Per-worker optimizer moments mirror the stacked param layout;
+        # stacked scalars ([W]-shaped counts) shard over the worker axis.
+        opt_sh = mirror_tree_specs(opt_state, locals_, stacked_sh, wshard)
+        model_state = _stack_for_workers(
+            jax.tree.map(lambda a: jnp.asarray(np.array(a)),
+                         self.model.state), W)
+        return EngineState(
+            center=put_global(center, center_sh),
+            locals_=put_global(locals_, stacked_sh),
+            opt_state=put_global(opt_state, opt_sh),
+            fold_state=put_global(fold_state, rep),
+            rng=put_global(rng, rep),
+            model_state=put_global(model_state, wshard),
+        )
+
+    def adopt_state(self, host: EngineState) -> EngineState:
+        W = self.num_workers
+        center = jax.tree.map(np.asarray, host.center)
+        model_state = jax.tree.map(
+            lambda a: np.mean(np.asarray(a), axis=0), host.model_state)
+        center_sh = self._center_shardings()
+        stacked_sh = self._stacked_shardings()
+        rep = NamedSharding(self.mesh, P())
+        wshard = NamedSharding(self.mesh, P(DATA_AXIS))
+        locals_ = _stack_for_workers(jax.tree.map(jnp.asarray, center), W)
+        opt_state = _stack_for_workers(self.tx.init(center), W)
+        opt_sh = mirror_tree_specs(opt_state, locals_, stacked_sh, wshard)
+        return EngineState(
+            center=put_global(center, center_sh),
+            locals_=put_global(locals_, stacked_sh),
+            opt_state=put_global(opt_state, opt_sh),
+            fold_state=put_global(host.fold_state, rep),
+            rng=put_global(host.rng, rep),
+            model_state=put_global(_stack_for_workers(
+                jax.tree.map(jnp.asarray, model_state), W), wshard),
+        )
+
+    # -- sharded-store locality (multi-process) ------------------------------
+    @property
+    def _local_ranks(self) -> list[int]:
+        if not hasattr(self, "_local_ranks_cache"):
+            from distkeras_tpu.parallel.runner import local_dp_ranks
+
+            self._local_ranks_cache = local_dp_ranks(self.mesh)
+        return self._local_ranks_cache
+
+    def _stage_local_round(self, plan, r):
+        # Worker w == data-axis rank w; its tp peers share the same rows.
+        lw = self._local_ranks
+        xs, ys = plan.round_local(r, lw)
+        put = lambda a: put_worker_local(
+            a, self.mesh, plan.num_workers, lw, 0, P(DATA_AXIS))
+        return put(xs), put(ys)
+
+    def _stage_local_block(self, plan, rs):
+        lw = self._local_ranks
+        batches = [plan.round_local(r, lw) for r in rs]
+        xs = np.stack([b[0] for b in batches])
+        ys = np.stack([b[1] for b in batches])
+        put = lambda a: put_worker_local(
+            a, self.mesh, plan.num_workers, lw, 1, P(None, DATA_AXIS))
+        return put(xs), put(ys)

@@ -485,21 +485,57 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
 
     communication_window = _config_prop("communication_window")
 
-    def __init__(self, *args, communication_window: int = 5, **kwargs):
+    def __init__(self, *args, communication_window: int = 5,
+                 parallel: Optional[dict] = None, rules=None, **kwargs):
         super().__init__(*args, **kwargs)
         self.config = self.config.replace(communication_window=communication_window)
+        #: each async worker as a model-parallel submesh:
+        #: ``parallel={"model": 2}`` makes every logical worker a tp=2
+        #: tensor-parallel replica (AsyncTPEngine over a (data, model)
+        #: mesh); ``rules`` overrides the PartitionSpec rule set (default
+        #: TRANSFORMER_TP_RULES).
+        self.parallel = dict(parallel) if parallel else None
+        self.rules = rules
 
     def _discipline(self) -> Discipline:
         raise NotImplementedError
 
-    def _run(self, dataframe: DataFrame, shuffle: bool):
-        mesh, m = self._mesh()
-        engine = AsyncEngine(
-            self.model, self.worker_optimizer, self.loss, self._discipline(), mesh,
-            window=self.communication_window, learning_rate=self.learning_rate,
+    def _tp_engine(self):
+        from distkeras_tpu.parallel.async_tp import AsyncTPEngine
+        from distkeras_tpu.parallel.sharding import TRANSFORMER_TP_RULES
+        from distkeras_tpu.runtime.mesh import hybrid_mesh
+
+        axes = dict(self.parallel)
+        tp = int(axes.pop("model", 1))
+        if axes:
+            raise ValueError(
+                f"async parallel supports only {{'model': n}}, got extra "
+                f"axes {sorted(axes)}; pipeline/seq/expert parallel compose "
+                "via ParallelTrainer instead")
+        W = self.num_workers or jax.device_count() // tp
+        mesh = hybrid_mesh({"data": W, "model": tp})
+        rules = self.rules if self.rules is not None else TRANSFORMER_TP_RULES
+        return AsyncTPEngine(
+            self.model, self.worker_optimizer, self.loss, self._discipline(),
+            mesh, window=self.communication_window, rules=rules,
+            learning_rate=self.learning_rate,
             compute_dtype=self.compute_dtype, seed=self.seed,
-            grad_accum=self.grad_accum, workers_per_chip=m,
+            grad_accum=self.grad_accum,
         )
+
+    def _run(self, dataframe: DataFrame, shuffle: bool):
+        if self.parallel:
+            engine = self._tp_engine()
+        else:
+            mesh, m = self._mesh()
+            engine = AsyncEngine(
+                self.model, self.worker_optimizer, self.loss,
+                self._discipline(), mesh,
+                window=self.communication_window,
+                learning_rate=self.learning_rate,
+                compute_dtype=self.compute_dtype, seed=self.seed,
+                grad_accum=self.grad_accum, workers_per_chip=m,
+            )
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,
             num_workers=engine.num_workers, window=self.communication_window,

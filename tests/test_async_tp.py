@@ -1,0 +1,138 @@
+"""Async disciplines x tensor parallelism (VERDICT r3 weak #5 / next #7).
+
+The composition the flat 1-D engine could not express: each async worker is
+itself a tp submesh. Pinned here: (a) on a TP-invariant model the (W=2, tp=2)
+run matches the flat W=2 run discipline-for-discipline (sharding never
+changes math); (b) a transformer genuinely tensor-shards under it and trains;
+(c) the reference-shaped trainer surface accepts ``parallel={'model': n}``.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.data.batching import make_batches
+from distkeras_tpu.data.dataframe import DataFrame
+from distkeras_tpu.models.base import Model
+from distkeras_tpu.models.mlp import MLP
+from distkeras_tpu.parallel.async_tp import AsyncTPEngine
+from distkeras_tpu.parallel.disciplines import get_discipline
+from distkeras_tpu.parallel.engine import AsyncEngine
+from distkeras_tpu.parallel.sharding import TRANSFORMER_TP_RULES
+from distkeras_tpu.runtime.mesh import data_mesh, hybrid_mesh
+
+
+def _blob_df(n=512, d=8, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=3.0, size=(c, d))
+    y = rng.integers(0, c, size=n)
+    x = (centers[y] + rng.normal(scale=0.5, size=(n, d))).astype(np.float32)
+    return DataFrame({"features": x, "label": y.astype(np.int32)})
+
+
+@pytest.mark.parametrize("disc_name", ["aeasgd", "adag", "dynsgd"])
+def test_tp_async_matches_flat_worker_run(disc_name):
+    """(W=2, tp=2) == flat W=2 on a TP-invariant model: same worker ids,
+    same rngs, same commits — sharding must not change the math."""
+    df = _blob_df()
+    model = Model.build(MLP(hidden=(16,), num_outputs=3),
+                        jnp.zeros((1, 8), jnp.float32))
+    W, window = 2, 2
+
+    def disc():
+        return (get_discipline("aeasgd", alpha=0.05) if disc_name == "aeasgd"
+                else get_discipline(disc_name))
+
+    plan = make_batches(df, "features", "label", batch_size=8, num_workers=W,
+                        window=window, num_epoch=2)
+    flat = AsyncEngine(model, "sgd", "sparse_categorical_crossentropy",
+                       disc(), data_mesh(num_workers=W), window=window,
+                       learning_rate=0.05)
+    tp = AsyncTPEngine(model, "sgd", "sparse_categorical_crossentropy",
+                       disc(), hybrid_mesh({"data": W, "model": 2}),
+                       window=window, rules=TRANSFORMER_TP_RULES,
+                       learning_rate=0.05)
+    state_flat, losses_flat = flat.run(plan)
+    state_tp, losses_tp = tp.run(plan)
+    np.testing.assert_allclose(losses_tp, losses_flat, rtol=2e-5, atol=1e-6)
+    # Final centers agree (engines are deterministic given the plan).
+    for a, b in zip(jax.tree.leaves(jax.device_get(state_tp.center)),
+                    jax.tree.leaves(jax.device_get(state_flat.center))):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+
+
+def test_transformer_tensor_shards_and_trains_under_aeasgd():
+    """The composition in anger: a TransformerLM whose per-worker replicas
+    are genuinely tp-sharded (param leaves carry the 'model' axis) trains
+    under AEASGD with a decreasing loss."""
+    from distkeras_tpu.models.transformer import TransformerLM
+
+    L, V = 16, 64
+    model = Model.build(
+        TransformerLM(vocab_size=V, num_layers=2, d_model=32, num_heads=2,
+                      d_ff=64, max_seq_len=L),
+        jnp.zeros((1, L), jnp.int32))
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, V, size=(512, L))
+    df = DataFrame({"features": toks.astype(np.int32),
+                    "label": np.roll(toks, -1, 1).astype(np.int32)})
+    W, window = 2, 2
+    plan = make_batches(df, "features", "label", batch_size=8, num_workers=W,
+                        window=window, num_epoch=2)
+    engine = AsyncTPEngine(
+        model, "adam", "sparse_categorical_crossentropy",
+        get_discipline("aeasgd", alpha=0.05),
+        hybrid_mesh({"data": W, "model": 2}), window=window,
+        rules=TRANSFORMER_TP_RULES, learning_rate=1e-3)
+    state = engine.init_state()
+
+    # The per-worker stacked replicas really shard over BOTH axes: worker
+    # axis 'data' on dim 0, tp axis 'model' on the rule-matched param dim.
+    flat = jax.tree_util.tree_flatten_with_path(state.locals_)[0]
+    tp_leaves = [
+        (path, leaf) for path, leaf in flat
+        if "mlp_up" in "/".join(str(getattr(p, "key", p)) for p in path)
+        and "kernel" in "/".join(str(getattr(p, "key", p)) for p in path)]
+    assert tp_leaves, "no mlp_up kernels found in stacked state"
+    for _, leaf in tp_leaves:
+        spec = leaf.sharding.spec
+        assert spec[0] == "data" and "model" in tuple(spec), spec
+
+    state, losses = engine.run(plan, state)
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-2:]) < np.mean(losses[:2])
+
+
+def test_trainer_surface_accepts_parallel_model():
+    """Reference-shaped call: AEASGD(model, num_workers=2,
+    parallel={'model': 2}).train(df) -> trained model."""
+    import distkeras_tpu as dk
+
+    df = _blob_df()
+    model = Model.build(MLP(hidden=(16,), num_outputs=3),
+                        jnp.zeros((1, 8), jnp.float32))
+    tr = dk.AEASGD(model, num_workers=2, parallel={"model": 2},
+                   batch_size=8, communication_window=2, num_epoch=2,
+                   loss="sparse_categorical_crossentropy", learning_rate=0.05)
+    trained = tr.train(df)
+    x = np.asarray(df["features"])
+    acc = (np.asarray(trained.predict(jnp.asarray(x))).argmax(-1)
+           == np.asarray(df["label"])).mean()
+    assert acc > 0.85, acc
+    assert len(tr.get_history()) == plan_rounds(512, 2, 2, 8) * 2
+
+
+def plan_rounds(n, W, K, B):
+    return n // (W * K * B)
+
+
+def test_parallel_rejects_unknown_axes_and_multiplex():
+    import distkeras_tpu as dk
+
+    model = Model.build(MLP(hidden=(8,), num_outputs=3),
+                        jnp.zeros((1, 8), jnp.float32))
+    with pytest.raises(ValueError, match="only {'model': n}"):
+        dk.AEASGD(model, num_workers=2, parallel={"pipe": 2},
+                  batch_size=8)._tp_engine()
