@@ -51,53 +51,91 @@ def _shard_file(shard: int, col: str) -> str:
     return f"shard-{shard:05d}.{col}.npy"
 
 
+_MERGE_JOURNAL = ".merge.journal.json"
+
+
 def merge_manifests(path: str) -> dict:
     """Splice ``part-*/`` writer outputs into one readable store.
 
-    Run ONCE after every :class:`ShardWriter` with ``part=k`` closed (e.g. by
+    Run after every :class:`ShardWriter` with ``part=k`` closed (e.g. by
     process 0 behind a barrier): renames each part's shard files into the
     global shard sequence in part-id order (same-filesystem renames — no data
     is copied), validates that every part wrote the same column schema, and
     publishes the root manifest atomically. Reads from the merged store are
     byte-identical to a single writer fed the concatenated row stream with
-    per-part shard boundaries."""
-    parts = sorted(d for d in os.listdir(path)
-                   if d.startswith("part-")
-                   and os.path.isdir(os.path.join(path, d)))
-    if not parts:
-        raise FileNotFoundError(f"no part-*/ writer directories under {path}")
-    columns: Optional[dict] = None
-    shard_rows: list[int] = []
-    g = 0
-    for d in parts:
-        pdir = os.path.join(path, d)
-        with open(os.path.join(pdir, _PART_MANIFEST)) as f:
-            pm = json.load(f)
-        if not pm["shard_rows"]:
-            os.remove(os.path.join(pdir, _PART_MANIFEST))
-            os.rmdir(pdir)
-            continue  # a writer that saw zero rows contributes nothing
+    per-part shard boundaries.
+
+    Crash-safe and idempotent: the full rename plan is journaled
+    (``.merge.journal.json``, atomic write) BEFORE any file moves, each move
+    is skip-if-already-done on replay, and the journal is removed only after
+    the root manifest publishes — so re-running after a crash at ANY point
+    resumes the same merge instead of restarting the shard counter over
+    already-spliced files (which would silently clobber them)."""
+    journal_path = os.path.join(path, _MERGE_JOURNAL)
+    if os.path.exists(journal_path):
+        with open(journal_path) as f:
+            plan = json.load(f)  # resume an interrupted merge
+    else:
+        parts = sorted(d for d in os.listdir(path)
+                       if d.startswith("part-")
+                       and os.path.isdir(os.path.join(path, d)))
+        if not parts:
+            raise FileNotFoundError(
+                f"no part-*/ writer directories under {path}")
+        columns: Optional[dict] = None
+        shard_rows: list[int] = []
+        moves: list[list] = []  # [part_dir, local_shard, global_shard]
+        g = 0
+        for d in parts:
+            with open(os.path.join(path, d, _PART_MANIFEST)) as f:
+                pm = json.load(f)
+            if not pm["shard_rows"]:
+                continue  # a writer that saw zero rows contributes nothing
+            if columns is None:
+                columns = pm["columns"]
+            elif pm["columns"] != columns:
+                raise ValueError(
+                    f"part {d} wrote a different column schema: "
+                    f"{pm['columns']} vs {columns}")
+            for i, rows in enumerate(pm["shard_rows"]):
+                moves.append([d, i, g])
+                shard_rows.append(int(rows))
+                g += 1
         if columns is None:
-            columns = pm["columns"]
-        elif pm["columns"] != columns:
-            raise ValueError(
-                f"part {d} wrote a different column schema: {pm['columns']} "
-                f"vs {columns}")
-        for i, rows in enumerate(pm["shard_rows"]):
-            for col in columns:
-                os.replace(os.path.join(pdir, _shard_file(i, col)),
-                           os.path.join(path, _shard_file(g, col)))
-            shard_rows.append(int(rows))
-            g += 1
-        os.remove(os.path.join(pdir, _PART_MANIFEST))
-        os.rmdir(pdir)
-    if columns is None:
-        raise ValueError(f"every part under {path} was empty")
+            raise ValueError(f"every part under {path} was empty")
+        plan = {"parts": parts, "columns": columns,
+                "shard_rows": shard_rows, "moves": moves}
+        tmp = journal_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(plan, f)
+        os.replace(tmp, journal_path)
+
+    for d, i, g in plan["moves"]:
+        for col in plan["columns"]:
+            src = os.path.join(path, d, _shard_file(i, col))
+            dst = os.path.join(path, _shard_file(g, col))
+            if os.path.exists(src):
+                os.replace(src, dst)
+            elif not os.path.exists(dst):
+                raise FileNotFoundError(
+                    f"merge cannot resume: neither {src} nor {dst} exists")
+    for d in plan["parts"]:
+        pdir = os.path.join(path, d)
+        try:
+            os.remove(os.path.join(pdir, _PART_MANIFEST))
+        except OSError:
+            pass
+        try:
+            os.rmdir(pdir)
+        except OSError:
+            pass
+
+    shard_rows = [int(r) for r in plan["shard_rows"]]
     offsets = np.concatenate([[0], np.cumsum(shard_rows)]).tolist()
     manifest = {
         "version": 1,
         "num_rows": int(offsets[-1]),
-        "columns": columns,
+        "columns": plan["columns"],
         "shard_rows": shard_rows,
         "shard_offsets": [int(o) for o in offsets[:-1]],
     }
@@ -105,6 +143,7 @@ def merge_manifests(path: str) -> dict:
     with open(tmp, "w") as f:
         json.dump(manifest, f)
     os.replace(tmp, os.path.join(path, _MANIFEST))
+    os.remove(journal_path)
     return manifest
 
 

@@ -38,7 +38,7 @@ from distkeras_tpu.parallel.engine import (
     put_worker_local,
 )
 from distkeras_tpu.parallel.sharding import mirror_tree_specs, param_path_specs
-from distkeras_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, put_global
+from distkeras_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS
 
 
 class AsyncTPEngine(AsyncEngine):
@@ -160,61 +160,13 @@ class AsyncTPEngine(AsyncEngine):
         self._round_core = round_fn
         return jax.jit(round_fn, donate_argnums=(0,))
 
-    # -- state ---------------------------------------------------------------
-    def init_state(self) -> EngineState:
-        W = self.num_workers
-        center = jax.tree.map(lambda a: np.array(a), self.model.params)
-        if self.per_worker_init:
-            per = [self.model.reinit_params(self.seed * 1009 + 1 + i)
-                   for i in range(W)]
-            locals_ = jax.tree.map(lambda *xs: jnp.stack(xs), *per)
-        else:
-            locals_ = _stack_for_workers(
-                jax.tree.map(jnp.asarray, center), W)
-        opt_state = _stack_for_workers(self.tx.init(center), W)
-        fold_state = self.discipline.init_state(center)
-        rng = jax.random.key(self.seed)
-
-        center_sh = self._center_shardings()
-        stacked_sh = self._stacked_shardings()
-        rep = NamedSharding(self.mesh, P())
-        wshard = NamedSharding(self.mesh, P(DATA_AXIS))
-        # Per-worker optimizer moments mirror the stacked param layout;
-        # stacked scalars ([W]-shaped counts) shard over the worker axis.
-        opt_sh = mirror_tree_specs(opt_state, locals_, stacked_sh, wshard)
-        model_state = _stack_for_workers(
-            jax.tree.map(lambda a: jnp.asarray(np.array(a)),
-                         self.model.state), W)
-        return EngineState(
-            center=put_global(center, center_sh),
-            locals_=put_global(locals_, stacked_sh),
-            opt_state=put_global(opt_state, opt_sh),
-            fold_state=put_global(fold_state, rep),
-            rng=put_global(rng, rep),
-            model_state=put_global(model_state, wshard),
-        )
-
-    def adopt_state(self, host: EngineState) -> EngineState:
-        W = self.num_workers
-        center = jax.tree.map(np.asarray, host.center)
-        model_state = jax.tree.map(
-            lambda a: np.mean(np.asarray(a), axis=0), host.model_state)
-        center_sh = self._center_shardings()
-        stacked_sh = self._stacked_shardings()
-        rep = NamedSharding(self.mesh, P())
-        wshard = NamedSharding(self.mesh, P(DATA_AXIS))
-        locals_ = _stack_for_workers(jax.tree.map(jnp.asarray, center), W)
-        opt_state = _stack_for_workers(self.tx.init(center), W)
-        opt_sh = mirror_tree_specs(opt_state, locals_, stacked_sh, wshard)
-        return EngineState(
-            center=put_global(center, center_sh),
-            locals_=put_global(locals_, stacked_sh),
-            opt_state=put_global(opt_state, opt_sh),
-            fold_state=put_global(host.fold_state, rep),
-            rng=put_global(host.rng, rep),
-            model_state=put_global(_stack_for_workers(
-                jax.tree.map(jnp.asarray, model_state), W), wshard),
-        )
+    def _opt_shardings(self, opt_state, locals_):
+        # Per-worker optimizer moments mirror the stacked tp param layout;
+        # stacked scalars ([W]-shaped counts) shard over the worker axis
+        # only. init_state/adopt_state themselves are inherited — the
+        # sharding hooks are the engines' ONLY state-layout difference.
+        return mirror_tree_specs(opt_state, locals_, self._stacked_shardings(),
+                                 NamedSharding(self.mesh, P(DATA_AXIS)))
 
     # -- sharded-store locality (multi-process) ------------------------------
     @property

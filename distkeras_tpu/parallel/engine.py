@@ -241,6 +241,19 @@ class AsyncEngine:
         return make_multi_round_fn(self, rounds)
 
     # ------------------------------------------------------------------
+    # Sharding hooks: the center is replicated and per-worker state shards
+    # on the worker axis. AsyncTPEngine overrides these (the ONLY layout
+    # difference) to add tensor-parallel param dims, so init_state and
+    # adopt_state are shared verbatim.
+    def _center_shardings(self):
+        return NamedSharding(self.mesh, P())
+
+    def _stacked_shardings(self):
+        return NamedSharding(self.mesh, P(DATA_AXIS))
+
+    def _opt_shardings(self, opt_state, locals_):
+        return self._stacked_shardings()
+
     def init_state(self) -> EngineState:
         W = self.num_workers
         # Deep-copy: round_fn donates its input state, and device_put may alias the
@@ -254,22 +267,24 @@ class AsyncEngine:
                    for i in range(W)]
             locals_ = jax.tree.map(lambda *xs: jnp.stack(xs), *per)
         else:
-            locals_ = _stack_for_workers(center, W)
+            locals_ = _stack_for_workers(
+                jax.tree.map(jnp.asarray, center), W)
         opt_state = _stack_for_workers(self.tx.init(center), W)
         fold_state = self.discipline.init_state(center)
         rng = jax.random.key(self.seed)
 
         rep = NamedSharding(self.mesh, P())
-        shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        wshard = NamedSharding(self.mesh, P(DATA_AXIS))
         model_state = _stack_for_workers(
             jax.tree.map(lambda a: jnp.asarray(np.array(a)), self.model.state), W)
         return EngineState(
-            center=put_global(center, rep),
-            locals_=put_global(locals_, shard),
-            opt_state=put_global(opt_state, shard),
+            center=put_global(center, self._center_shardings()),
+            locals_=put_global(locals_, self._stacked_shardings()),
+            opt_state=put_global(opt_state,
+                                 self._opt_shardings(opt_state, locals_)),
             fold_state=put_global(fold_state, rep),
             rng=put_global(rng, rep),
-            model_state=put_global(model_state, shard),
+            model_state=put_global(model_state, wshard),
         )
 
     def host_state(self, num_workers: int) -> EngineState:
@@ -310,20 +325,21 @@ class AsyncEngine:
         carry over exactly."""
         W = self.num_workers
         rep = NamedSharding(self.mesh, P())
-        shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        wshard = NamedSharding(self.mesh, P(DATA_AXIS))
         center = jax.tree.map(np.asarray, host.center)
         model_state = jax.tree.map(
             lambda a: np.mean(np.asarray(a), axis=0), host.model_state)
+        locals_ = _stack_for_workers(jax.tree.map(jnp.asarray, center), W)
+        opt_state = _stack_for_workers(self.tx.init(center), W)
         return EngineState(
-            center=put_global(center, rep),
-            locals_=put_global(_stack_for_workers(
-                jax.tree.map(jnp.asarray, center), W), shard),
-            opt_state=put_global(_stack_for_workers(
-                self.tx.init(center), W), shard),
+            center=put_global(center, self._center_shardings()),
+            locals_=put_global(locals_, self._stacked_shardings()),
+            opt_state=put_global(opt_state,
+                                 self._opt_shardings(opt_state, locals_)),
             fold_state=put_global(host.fold_state, rep),
             rng=put_global(host.rng, rep),
             model_state=put_global(_stack_for_workers(
-                jax.tree.map(jnp.asarray, model_state), W), shard),
+                jax.tree.map(jnp.asarray, model_state), W), wshard),
         )
 
     def _put_batch(self, xs: np.ndarray, ys: np.ndarray):

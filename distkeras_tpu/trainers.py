@@ -512,7 +512,13 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                 f"async parallel supports only {{'model': n}}, got extra "
                 f"axes {sorted(axes)}; pipeline/seq/expert parallel compose "
                 "via ParallelTrainer instead")
-        W = self.num_workers or jax.device_count() // tp
+        devices = jax.device_count()
+        W = self.num_workers or devices // tp
+        if W < 1 or W * tp > devices:
+            raise ValueError(
+                f"parallel={{'model': {tp}}} with num_workers={self.num_workers} "
+                f"needs num_workers*{tp} <= {devices} available devices "
+                f"(and at least one worker); got W={W}")
         mesh = hybrid_mesh({"data": W, "model": tp})
         rules = self.rules if self.rules is not None else TRANSFORMER_TP_RULES
         return AsyncTPEngine(

@@ -126,6 +126,51 @@ def test_part_writers_merge_identical_to_single_writer(tmp_path):
     assert not any(f.startswith("part-") for f in os.listdir(multi))
 
 
+def test_merge_manifests_resumes_after_crash(tmp_path, monkeypatch):
+    """A crash mid-splice must NOT corrupt the store on retry: the journaled
+    plan replays idempotently instead of restarting the shard counter over
+    already-moved files (r4 review finding)."""
+    import os as _os
+
+    from distkeras_tpu.data import shards as shards_mod
+    from distkeras_tpu.data.shards import merge_manifests
+
+    x, y = _blobs(n=256)
+    single = tmp_path / "single"
+    write_shards(single, {"features": x, "label": y}, rows_per_shard=64)
+    multi = tmp_path / "multi"
+    for part in range(2):
+        lo, hi = part * 128, (part + 1) * 128
+        with ShardWriter(multi, rows_per_shard=64, part=part) as w:
+            w.append(features=x[lo:hi], label=y[lo:hi])
+
+    real_replace = _os.replace
+    calls = {"n": 0}
+
+    def flaky(src, dst):
+        calls["n"] += 1
+        if calls["n"] == 4:  # after the journal write + some shard moves
+            raise OSError("simulated crash mid-merge")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(shards_mod.os, "replace", flaky)
+    with pytest.raises(OSError, match="simulated crash"):
+        merge_manifests(multi)
+    monkeypatch.setattr(shards_mod.os, "replace", real_replace)
+    assert (multi / ".merge.journal.json").exists()
+
+    manifest = merge_manifests(multi)  # resume
+    ref = ShardStore.open(single)
+    got = ShardStore.open(multi)
+    assert manifest["shard_rows"] == ref.manifest["shard_rows"]
+    ids = np.arange(256)
+    np.testing.assert_array_equal(got.gather("features", ids),
+                                  ref.gather("features", ids))
+    np.testing.assert_array_equal(got.gather("label", ids),
+                                  ref.gather("label", ids))
+    assert not (multi / ".merge.journal.json").exists()
+
+
 def test_merge_manifests_rejects_schema_mismatch(tmp_path):
     from distkeras_tpu.data.shards import merge_manifests
 
