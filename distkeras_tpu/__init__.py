@@ -63,6 +63,7 @@ from distkeras_tpu.data import (  # noqa: F401
     ShardedDataFrame,
     ShardStore,
     ShardWriter,
+    merge_manifests,
     write_shards,
     DenseTransformer,
     LabelIndexTransformer,
@@ -100,6 +101,7 @@ __all__ = [
     "ShardedDataFrame",
     "ShardStore",
     "ShardWriter",
+    "merge_manifests",
     "write_shards",
     "Transformer",
     "LabelIndexTransformer",

@@ -20,6 +20,7 @@ from distkeras_tpu.data.shards import (  # noqa: F401
     ShardedDataFrame,
     ShardStore,
     ShardWriter,
+    merge_manifests,
     write_shards,
 )
 
@@ -28,6 +29,7 @@ __all__ = [
     "ShardedDataFrame",
     "ShardStore",
     "ShardWriter",
+    "merge_manifests",
     "ShardedBatchPlan",
     "write_shards",
     "Transformer",
