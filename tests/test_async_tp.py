@@ -128,6 +128,40 @@ def plan_rounds(n, W, K, B):
     return n // (W * K * B)
 
 
+def test_checkpoint_resume_under_tp_async(tmp_path):
+    """The full trainer surface holds for the composed engine: a
+    checkpointed W=2 x tp=2 AEASGD run resumes to exactly the
+    uninterrupted run's weights (shared init/adopt sharding hooks)."""
+    pytest.importorskip("orbax.checkpoint")
+    import distkeras_tpu as dk
+
+    df = _blob_df()
+
+    def model():
+        return Model.build(MLP(hidden=(16,), num_outputs=3),
+                           jnp.zeros((1, 8), jnp.float32))
+
+    ck = str(tmp_path / "ck")
+    common = dict(loss="sparse_categorical_crossentropy", num_workers=2,
+                  parallel={"model": 2}, batch_size=8,
+                  communication_window=2, learning_rate=0.05)
+    t_full = dk.AEASGD(model(), num_epoch=4, **common)
+    m_full = t_full.train(df)
+
+    t_a = dk.AEASGD(model(), num_epoch=2, checkpoint_dir=ck,
+                    checkpoint_every=1, **common)
+    t_a.train(df)
+    t_b = dk.AEASGD(model(), num_epoch=4, checkpoint_dir=ck,
+                    checkpoint_every=1, resume=True, **common)
+    m_b = t_b.train(df)
+
+    assert (len(t_b.get_history())
+            == len(t_full.get_history()) - len(t_a.get_history()))
+    for a, b in zip(jax.tree.leaves(m_full.params),
+                    jax.tree.leaves(m_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_parallel_rejects_unknown_axes_and_multiplex():
     import distkeras_tpu as dk
 
