@@ -37,6 +37,13 @@ class RoundFeeder:
         self._q: queue.Queue = queue.Queue(maxsize=self.depth)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
+        #: consumer-side seconds blocked waiting for each yielded round —
+        #: the feed-overlap diagnostic. Because jax dispatch is async, the
+        #: consumer loop runs ahead of the device; per-round waits beyond
+        #: the warmup round mean the gather+transform+device_put pipeline
+        #: is slower than the dispatch loop (staging NOT hidden). Summed by
+        #: the engine run loops into ``engine.feed_wait_seconds``.
+        self.waits: list[float] = []
 
     def _put(self, item) -> bool:
         """Blocking put that aborts (returns False) once close() is called."""
@@ -89,22 +96,30 @@ class RoundFeeder:
             # too): fail loudly rather than silently yielding zero rounds.
             raise RuntimeError(
                 "RoundFeeder is closed; construct a new feeder per run")
+        import time
+
         self._thread.start()
         try:
+            wait = 0.0
             while True:
+                t0 = time.perf_counter()
                 try:
                     # Timed get: a concurrent close() suppresses the
                     # sentinel (the stopped feeder never enqueues it), so an
                     # untimed get would block forever.
                     r, batch, err = self._q.get(timeout=0.1)
                 except queue.Empty:
+                    wait += time.perf_counter() - t0
                     if self._stop.is_set():
                         return
                     continue
+                wait += time.perf_counter() - t0
                 if err is not None:
                     raise err
                 if r is None:
                     return
+                self.waits.append(wait)
+                wait = 0.0
                 yield r, batch
         finally:
             # Runs on normal exhaustion AND on abandonment (consumer raised /

@@ -28,6 +28,20 @@ def _coerce(v):
     return tuple(_coerce(x) for x in v) if isinstance(v, list) else v
 
 
+def normalize_features(x):
+    """uint8 feature arrays are raw image bytes: ``x/255`` as float32.
+
+    The one normalization rule, shared by the training loop
+    (``workers.make_local_loop``, which additionally casts to the compute
+    dtype) and every inference path (:meth:`Model.apply`,
+    ``predictors.ModelPredictor``) — uint8 stores must see identical inputs
+    train-side and predict-side. Integer token/label inputs are int32/int64
+    and pass through untouched."""
+    if getattr(x, "dtype", None) == jnp.uint8:
+        return x.astype(jnp.float32) / 255.0
+    return x
+
+
 class DKModule(nn.Module):
     """Base class for zoo modules: adds the config round-trip used by serialization."""
 
@@ -93,11 +107,15 @@ class Model:
         """Pure forward pass — the jit-safe core of ``model.predict``/``train_on_batch``.
 
         Inference-mode by default: mutable collections (``state`` or the
-        model's own) are read, never updated.
+        model's own) are read, never updated. uint8 feature arrays are
+        normalized ``x/255`` exactly as the training loop does
+        (``workers.make_local_loop``) — train/inference inputs must never
+        skew for raw-byte image stores.
         """
         rngs = {"dropout": rng} if rng is not None else None
         variables = {"params": params, **((state if state is not None
                                            else self.state) or {})}
+        inputs = tuple(normalize_features(x) for x in inputs)
         return self.module.apply(variables, *inputs, train=train, rngs=rngs)
 
     def predict(self, *inputs):

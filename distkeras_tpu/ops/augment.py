@@ -1,0 +1,56 @@
+"""On-device image augmentation — the jitted half of the data plane.
+
+The host-side ``Trainer(transform=...)`` hook (``data/batching.py::
+apply_round_transform``) covers arbitrary numpy transforms, but image
+augmentation is cheap VPU work and expensive host work: at the BASELINE #5
+shape the numpy crop/flip costs ~275 ms/round on this box's two host cores
+while the whole ResNet round is 119 ms on-chip (docs/PERFORMANCE.md "Feed
+overlap"). These transforms run INSIDE the jitted round program instead —
+``Trainer(device_transform=...)`` — so the host stages raw uint8 rows and
+the chip does the rest: flip/crop on device, normalization in-graph
+(``workers.make_local_loop`` divides uint8 by 255 after the transform).
+
+Determinism contract matches the host hook: the key handed in derives from
+the engine's replicated rng chain folded with the worker id, so the same
+(seed, round, worker) always augments identically — across engines,
+rounds-per-program blocking, and restarts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def random_flip_crop(rng: jax.Array, images: jax.Array, pad: int = 4):
+    """Per-image random horizontal flip + random ``pad``-reflected crop.
+
+    ``images``: ``[B, H, W, C]``, any dtype (uint8 stays uint8 — normalize
+    downstream). One ``vmap`` of ``dynamic_slice`` — no gather matmul, no
+    host round-trips.
+    """
+    B, H, W, _ = images.shape
+    k1, k2, k3 = jax.random.split(rng, 3)
+    flip = jax.random.bernoulli(k1, 0.5, (B,))
+    out = jnp.where(flip[:, None, None, None], images[:, :, ::-1], images)
+    padded = jnp.pad(out, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                     mode="reflect")
+    ys = jax.random.randint(k2, (B,), 0, 2 * pad + 1)
+    xs = jax.random.randint(k3, (B,), 0, 2 * pad + 1)
+
+    def crop(img, y, x):
+        return jax.lax.dynamic_slice(
+            img, (y, x, 0), (H, W, img.shape[-1]))
+
+    return jax.vmap(crop)(padded, ys, xs)
+
+
+def flip_crop_transform(pad: int = 4):
+    """A ``Trainer(device_transform=...)``-shaped wrapper:
+    ``fn(rng, x, y) -> (x, y)`` applying :func:`random_flip_crop` to the
+    features and passing labels through."""
+
+    def transform(rng, x, y):
+        return random_flip_crop(rng, x, pad=pad), y
+
+    return transform

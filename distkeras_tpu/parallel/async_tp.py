@@ -1,27 +1,37 @@
-"""Async disciplines x tensor parallelism: each logical worker IS a submesh.
+"""Async disciplines x tensor/sequence parallelism: each worker IS a submesh.
 
 The reference's workers were single-GPU processes, so its async disciplines
 never composed with model parallelism (SURVEY.md §2 parallelism inventory).
 On TPU there is no reason a "worker" must be one chip: this engine runs the
-same five discipline folds over a 2-D ``(data, model)`` mesh — the ``data``
-axis indexes logical workers, and each worker's replica (params, optimizer
-state, forward/backward) is tensor-sharded over ``model`` by the standard
-PartitionSpec rules (``parallel/sharding.py``). AEASGD across 8 workers each
+same five discipline folds over a ``(data[, seq], model)`` mesh — the
+``data`` axis indexes logical workers, and each worker's replica (params,
+optimizer state, forward/backward) is tensor-sharded over ``model`` by the
+standard PartitionSpec rules (``parallel/sharding.py``) and, for sequence
+models, activation-sharded over ``seq``. AEASGD across 8 workers each
 holding a tp=2 transformer becomes expressible::
 
     AEASGD(model, num_workers=8, parallel={"model": 2}).train(df)
 
-Mechanics: where :class:`~.engine.AsyncEngine` shard_maps one worker per
-chip and folds with an explicit ``psum``, this engine is pure GSPMD — the
-per-worker state is stacked ``[W, ...]`` and sharded ``P('data', *tp_spec)``,
-the window of local steps runs under ``jax.vmap`` over the worker axis, and
-the fold's cross-worker sum is a plain ``sum(axis=0)`` that XLA lowers to the
-same single all-reduce over ``data`` (while the TP all-reduces ride
-``model``). Discipline semantics are shared verbatim: the engine calls the
-same ``Discipline.commit`` the shard_map engine folds, so worker ids,
-staleness rotation, and elastic moves are identical — the flat-mesh and
+Mechanics: the engine reuses :class:`~.engine.AsyncEngine`'s round body
+verbatim under a *partially manual* ``shard_map`` — ``data`` (and ``seq``)
+are manual axes, so the discipline fold is the same explicit ``psum`` the
+flat engine issues and ring collectives have a bound axis name, while
+``model`` stays a GSPMD (auto) axis, so XLA inserts the tensor-parallel
+all-reduces from the PartitionSpec rules exactly as in
+:class:`~.spmd.SPMDEngine`. Because ``model`` is auto, the flash-attention
+Mosaic kernel self-manualizes over its heads via the nested ``shard_map`` in
+``models/transformer.py`` — ``attn_impl='flash'`` composes with every
+discipline (the r4 engine's pure-GSPMD design could not express this; its
+guard is gone). Sequence parallelism composes the same way: the per-step
+gradient/loss ``pmean`` over ``seq`` rides :func:`_grad_transform`, and ring
+attention ``ppermute``s K/V blocks over the manual ``seq`` axis.
+
+Discipline semantics are shared verbatim: worker ids, staleness rotation,
+and elastic moves are identical to the flat engine, so flat-mesh and
 tp-mesh runs of a TP-invariant model agree to float tolerance
-(``tests/test_async_tp.py``).
+(``tests/test_async_tp.py``). The per-worker ``[W]`` loss leaves the
+shard_map with spec ``P()`` — replicated, hence fully addressable on every
+process of a multi-host mesh.
 """
 
 from __future__ import annotations
@@ -31,19 +41,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from distkeras_tpu.parallel.engine import (
-    AsyncEngine,
-    EngineState,
-    _stack_for_workers,
-    put_worker_local,
-)
+from distkeras_tpu.parallel.engine import AsyncEngine, EngineState, put_worker_local
 from distkeras_tpu.parallel.sharding import mirror_tree_specs, param_path_specs
-from distkeras_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS
+from distkeras_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
 
 class AsyncTPEngine(AsyncEngine):
-    """A :class:`Discipline` over a ``(data, model)`` mesh: ``data`` indexes
-    workers, ``model`` tensor-shards every worker's replica under ``rules``.
+    """A :class:`Discipline` over a ``(data[, seq], model)`` mesh: ``data``
+    indexes workers, ``model`` tensor-shards every worker's replica under
+    ``rules``, ``seq`` (optional) shards sequence activations.
     """
 
     def __init__(self, model, optimizer, loss, discipline, mesh, window,
@@ -58,23 +64,82 @@ class AsyncTPEngine(AsyncEngine):
                 f"AsyncTPEngine needs a '{MODEL_AXIS}' mesh axis, got "
                 f"{mesh.axis_names}; use hybrid_mesh({{'data': W, "
                 "'model': tp}})")
-        # Same guards as GSPMDEngine: a pure-GSPMD engine binds no named
-        # mesh axes, so Mosaic custom calls and named-axis collectives
-        # cannot partition/engage under it.
-        if getattr(model.module, "attn_impl", None) == "flash":
+        seq_axis = getattr(model.module, "seq_axis", None)
+        has_seq = SEQ_AXIS in mesh.axis_names
+        if seq_axis is not None and not has_seq:
             raise ValueError(
-                "AsyncTPEngine cannot host attn_impl='flash': the Mosaic "
-                "kernel is not GSPMD-auto-partitionable. Use "
-                "attn_impl='dense' (XLA fuses the attention) for the "
-                "async-TP composition.")
-        if getattr(model.module, "seq_axis", None) is not None:
+                f"model was built with seq_axis={seq_axis!r} but the mesh "
+                f"has no '{SEQ_AXIS}' axis; pass parallel={{'model': tp, "
+                "'seq': s}} (or rebuild the model with seq_axis=None)")
+        if has_seq and mesh.shape[SEQ_AXIS] > 1 and seq_axis != SEQ_AXIS:
             raise ValueError(
-                "AsyncTPEngine cannot host sequence parallelism "
-                "(seq_axis set): ring collectives need a shard_map-bound "
-                "axis. Use SPMDEngine/ParallelTrainer for sp.")
+                f"mesh has a '{SEQ_AXIS}' axis of size "
+                f"{mesh.shape[SEQ_AXIS]} but the model was not built with "
+                f"seq_axis='{SEQ_AXIS}' — it would silently ignore the "
+                "sequence sharding. Build the model with seq_axis='seq' "
+                "and attn_impl='ring' or 'gather'.")
+        if (has_seq and mesh.shape[SEQ_AXIS] > 1 and model.state_collections
+                and not discipline.syncs_state):
+            # Each seq shard would update running stats from only its own
+            # L/S positions; without the state-syncing pmean the shards
+            # diverge and the engine's seq-replicated out_spec is silently
+            # violated (check_vma=False).
+            raise ValueError(
+                "sequence parallelism with a stateful model (collections "
+                f"{model.state_collections}) requires a state-syncing "
+                "discipline; the non-syncing "
+                f"{type(discipline).__name__} would let per-shard running "
+                "statistics diverge across seq shards.")
         self.rules = tuple(rules)
         super().__init__(model, optimizer, loss, discipline, mesh, window,
                          **kwargs)
+
+    # -- round-program hooks (see AsyncEngine._build_round_fn) ---------------
+    def _manual_axes(self):
+        axes = {DATA_AXIS}
+        if SEQ_AXIS in self.mesh.axis_names:
+            axes.add(SEQ_AXIS)
+        return axes
+
+    def _batch_spec(self) -> P:
+        if SEQ_AXIS in self.mesh.axis_names:
+            # LM-shaped batches [W, K, B, L]: sequence dim sharded over seq.
+            return P(DATA_AXIS, None, None, SEQ_AXIS)
+        return P(DATA_AXIS)
+
+    def _grad_transform(self):
+        if SEQ_AXIS not in self.mesh.axis_names:
+            return None
+
+        def seq_mean(grads, loss):
+            # Each seq shard back-props its own L/S positions; the full
+            # step gradient (and reported loss) is their mean, after which
+            # every shard applies the identical update — replicas never
+            # diverge over seq (same contract as SPMDEngine's pmean pair).
+            return (jax.lax.pmean(grads, SEQ_AXIS),
+                    jax.lax.pmean(loss, SEQ_AXIS))
+
+        return seq_mean
+
+    def _fold_rng(self, rng, wid):
+        r = jax.random.fold_in(rng, wid)
+        if SEQ_AXIS in self.mesh.axis_names:
+            # Independent dropout masks per sequence shard (each shard holds
+            # different positions), as in SPMDEngine's step rng.
+            r = jax.random.fold_in(r, jax.lax.axis_index(SEQ_AXIS))
+        return r
+
+    def _pin_state(self, state: EngineState) -> EngineState:
+        # Pin the big tensors' layouts so GSPMD cannot drift them between
+        # rounds (donation reuses the input buffers round over round).
+        wsc = jax.lax.with_sharding_constraint
+        center = jax.tree.map(wsc, state.center, self._center_shardings())
+        locals_ = jax.tree.map(wsc, state.locals_, self._stacked_shardings())
+        opt_state = jax.tree.map(
+            wsc, state.opt_state,
+            self._opt_shardings(state.opt_state, state.locals_))
+        return state._replace(center=center, locals_=locals_,
+                              opt_state=opt_state)
 
     # -- sharding layouts ----------------------------------------------------
     def _restrict(self, spec: P) -> P:
@@ -104,62 +169,6 @@ class AsyncTPEngine(AsyncEngine):
                                     P(DATA_AXIS, *self._restrict(s))),
             self._param_specs(), is_leaf=lambda x: isinstance(x, P))
 
-    # -- the round program ---------------------------------------------------
-    def _build_round_fn(self):
-        disc = self.discipline
-        window = self.window
-        W = self.num_workers
-        local_loop = self._local_loop
-        center_sh = self._center_shardings()
-        stacked_sh = self._stacked_shardings()
-
-        def wsc(tree, sh):
-            return jax.tree.map(jax.lax.with_sharding_constraint, tree, sh)
-
-        def round_fn(state: EngineState, xs, ys):
-            center, locals_, opt_state = (state.center, state.locals_,
-                                          state.opt_state)
-            fold_state, rng, model_state = (state.fold_state, state.rng,
-                                            state.model_state)
-            wids = jnp.arange(W)
-            start = (_stack_for_workers(center, W) if disc.pulls_center
-                     else locals_)
-            worker_rngs = jax.vmap(lambda w: jax.random.fold_in(rng, w))(wids)
-            new_local, new_opt, mstate, losses = jax.vmap(local_loop)(
-                start, opt_state, xs, ys, worker_rngs, model_state)
-            if disc.syncs_state:
-                # Cross-worker mean of mutable stats (same semantics as the
-                # shard_map engine's pmean over the worker axis).
-                mstate = jax.tree.map(
-                    lambda a: jnp.broadcast_to(
-                        a.mean(axis=0, keepdims=True), a.shape), mstate)
-            if disc.communicates:
-                commits, new_local = jax.vmap(
-                    lambda loc, w: disc.commit(
-                        center, loc, fold_state, worker_id=w, window=window,
-                        num_workers=W))(new_local, wids)
-                # GSPMD lowers this to ONE all-reduce over `data` — the
-                # exact psum of the shard_map fold.
-                total = jax.tree.map(lambda a: a.sum(axis=0), commits)
-                new_center = jax.tree.map(jnp.add, center, total)
-                if disc.pulls_center:
-                    new_local = _stack_for_workers(new_center, W)
-            else:
-                new_center = center
-            # Pin the two big tensors' layouts so GSPMD cannot drift them
-            # between rounds (donation reuses the input buffers).
-            new_center = wsc(new_center, center_sh)
-            new_local = wsc(new_local, stacked_sh)
-            loss = jnp.mean(losses, axis=tuple(range(1, losses.ndim)))  # [W]
-            next_rng = jax.random.split(rng, 1)[0]
-            new_state = EngineState(new_center, new_local, new_opt,
-                                    disc.advance(fold_state), next_rng,
-                                    mstate)
-            return new_state, loss
-
-        self._round_core = round_fn
-        return jax.jit(round_fn, donate_argnums=(0,))
-
     def _opt_shardings(self, opt_state, locals_):
         # Per-worker optimizer moments mirror the stacked tp param layout;
         # stacked scalars ([W]-shaped counts) shard over the worker axis
@@ -182,7 +191,7 @@ class AsyncTPEngine(AsyncEngine):
         lw = self._local_ranks
         xs, ys = plan.round_local(r, lw)
         put = lambda a: put_worker_local(
-            a, self.mesh, plan.num_workers, lw, 0, P(DATA_AXIS))
+            a, self.mesh, plan.num_workers, lw, 0, self._batch_spec())
         return put(xs), put(ys)
 
     def _stage_local_block(self, plan, rs):
@@ -191,5 +200,5 @@ class AsyncTPEngine(AsyncEngine):
         xs = np.stack([b[0] for b in batches])
         ys = np.stack([b[1] for b in batches])
         put = lambda a: put_worker_local(
-            a, self.mesh, plan.num_workers, lw, 1, P(None, DATA_AXIS))
+            a, self.mesh, plan.num_workers, lw, 1, P(None, *self._batch_spec()))
         return put(xs), put(ys)

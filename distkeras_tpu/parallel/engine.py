@@ -83,9 +83,20 @@ class AsyncEngine:
         per_worker_init: bool = False,
         grad_accum: int = 1,
         workers_per_chip: int = 1,
+        device_transform=None,
     ):
         self.model = model
         self.mesh = mesh
+        from distkeras_tpu.runtime.mesh import SEQ_AXIS
+
+        if (getattr(model.module, "seq_axis", None) is not None
+                and SEQ_AXIS not in mesh.axis_names):
+            raise ValueError(
+                f"model was built with seq_axis="
+                f"{model.module.seq_axis!r} but this engine's mesh has no "
+                f"'{SEQ_AXIS}' axis — the module's axis_index would be "
+                "unbound. Pass parallel={'model': tp, 'seq': s} (AsyncTP"
+                "Engine) or rebuild the model with seq_axis=None.")
         self.discipline = discipline
         self.window = window
         self.workers_per_chip = int(workers_per_chip)
@@ -102,9 +113,38 @@ class AsyncEngine:
         self._local_loop = make_local_loop(
             model.module, self.loss_fn, self.tx, compute_dtype=compute_dtype,
             state_collections=model.state_collections, grad_accum=grad_accum,
+            grad_transform=self._grad_transform(),
+            input_transform=device_transform,
         )
         self._multi_fns = {}
         self._round_fn = self._build_round_fn()
+
+    # ------------------------------------------------------------------
+    # Round-program hooks. The flat engine's shard_map binds every mesh axis
+    # manually (its mesh is 1-D ``data``); AsyncTPEngine overrides these to
+    # keep ``model`` a GSPMD (auto) axis — which is what lets non-auto-
+    # partitionable code (the Mosaic flash kernel) self-manualize inside the
+    # body — and to add a manual ``seq`` axis for sequence parallelism.
+    def _manual_axes(self):
+        """Axes shard_map binds manually; None = all mesh axes (flat engine)."""
+        return None
+
+    def _batch_spec(self) -> P:
+        """shard_map spec for the [W, K, B, ...] round batches."""
+        return P(DATA_AXIS)
+
+    def _grad_transform(self):
+        """Per-step (grads, loss) hook for the local loop (seq-axis pmean)."""
+        return None
+
+    def _fold_rng(self, rng, wid):
+        """Per-worker rng derivation inside the round body."""
+        return jax.random.fold_in(rng, wid)
+
+    def _pin_state(self, state: "EngineState") -> "EngineState":
+        """Pin output shardings (no-op for the all-manual flat engine, whose
+        out_specs fully determine layout)."""
+        return state
 
     # ------------------------------------------------------------------
     def _build_round_fn(self):
@@ -113,6 +153,16 @@ class AsyncEngine:
         num_workers = self.num_workers
         m = self.workers_per_chip
         local_loop = self._local_loop
+        fold_rng = self._fold_rng
+        manual = self._manual_axes()
+        from distkeras_tpu.runtime.mesh import SEQ_AXIS
+
+        # A manual seq axis shards each worker's batch positions: mutable
+        # state (running stats) updates from only L/S positions per shard,
+        # so the cross-worker state fold must also mean over seq — the
+        # out_spec claims seq-replication, and check_vma=False would let a
+        # silent divergence through otherwise.
+        seq_manual = bool(manual) and SEQ_AXIS in manual
 
         def _one_worker(center, locals_, opt_state, fold_state, rng,
                         model_state, xs, ys):
@@ -126,11 +176,13 @@ class AsyncEngine:
             xs0, ys0 = xs[0], ys[0]  # [K, B, ...]
             wid = jax.lax.axis_index(DATA_AXIS)
             start = center if disc.pulls_center else local
-            worker_rng = jax.random.fold_in(rng, wid)
+            worker_rng = fold_rng(rng, wid)
             new_local, new_opt, mstate, losses = local_loop(
                 start, opt, xs0, ys0, worker_rng, mstate)
             if disc.syncs_state:
                 mstate = lax.pmean(mstate, DATA_AXIS)
+                if seq_manual:
+                    mstate = lax.pmean(mstate, SEQ_AXIS)
             # disc.fold = commit + psum + pulls_center + advance: the
             # single-worker reference semantics live in ONE place
             # (disciplines.py); only the m>1 path inlines the vmapped twin.
@@ -207,14 +259,17 @@ class AsyncEngine:
                 loss,
             )  # loss: replicated [W]
 
+        batch_spec = self._batch_spec()
+        sm_kwargs = {} if manual is None else {"axis_names": frozenset(manual)}
         mapped = shard_map(
             body,
             mesh=self.mesh,
             in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS),
-                      P(DATA_AXIS), P(DATA_AXIS)),
+                      batch_spec, batch_spec),
             out_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(), P(DATA_AXIS),
                        P()),
             check_vma=False,
+            **sm_kwargs,
         )
 
         def round_fn(state: EngineState, xs, ys):
@@ -222,8 +277,9 @@ class AsyncEngine:
                 state.center, state.locals_, state.opt_state, state.fold_state,
                 state.rng, state.model_state, xs, ys,
             )
-            return EngineState(center, locals_, opt_state, fold_state, rng,
-                               model_state), loss
+            return self._pin_state(
+                EngineState(center, locals_, opt_state, fold_state, rng,
+                            model_state)), loss
 
         self._round_core = round_fn
         return jax.jit(round_fn, donate_argnums=(0,))
@@ -343,7 +399,7 @@ class AsyncEngine:
         )
 
     def _put_batch(self, xs: np.ndarray, ys: np.ndarray):
-        shard = NamedSharding(self.mesh, P(DATA_AXIS))
+        shard = NamedSharding(self.mesh, self._batch_spec())
         return put_global(xs, shard), put_global(ys, shard)
 
     def run(
@@ -439,7 +495,11 @@ def stage_round(engine, plan, r: int):
 
 def stage_block(engine, plan, rs) -> tuple:
     """Stage a ``[R, W, K, B, ...]`` block of rounds (worker axis at dim 1)."""
-    spec = P(None, DATA_AXIS)
+    # Engines with a batch-spec hook (seq-sharded AsyncTP) stage the block in
+    # the round body's layout — otherwise XLA reshards the full block inside
+    # every dispatched program.
+    batch_spec = getattr(engine, "_batch_spec", None)
+    spec = P(None, *batch_spec()) if batch_spec else P(None, DATA_AXIS)
     if (getattr(plan, "is_local", False) and jax.process_count() > 1
             and hasattr(engine, "_stage_local_block")):
         # Step engines: locality by dp rank, engine-owned specs.
@@ -502,6 +562,11 @@ def run_per_round(engine, plan, state, start_round, on_round):
         # traceback's frames) is retained by the caller — generator GC alone
         # would leave the feeder staging batches indefinitely.
         feeder.close()
+        # Feed-overlap diagnostic (see RoundFeeder.waits): per-round consumer
+        # block times; near-zero past round 0 = staging fully hidden behind
+        # dispatch. docs/PERFORMANCE.md "Feed overlap" measures this in anger.
+        engine.feed_waits = list(feeder.waits)
+        engine.feed_wait_seconds = float(sum(feeder.waits))
     # One batched fetch — per-item np.asarray would pay one D2H round-trip
     # (~70-110 ms through a tunneled device) per round.
     return state, np.asarray(jax.device_get(losses))
@@ -673,6 +738,8 @@ def run_blocked(engine, plan, state, start_round, on_round, R):
             state = new_state
     finally:
         feeder.close()  # deterministic even if the exception is retained
+        engine.feed_waits = list(feeder.waits)
+        engine.feed_wait_seconds = float(sum(feeder.waits))
     if losses and on_round is None:  # device blocks: one batched fetch
         losses = list(np.concatenate(jax.device_get(losses), axis=0))
     return state, np.asarray(losses)

@@ -50,6 +50,7 @@ class SyncEngine:
         seed: int = 0,
         grad_accum: int = 1,
         workers_per_chip: int = 1,
+        device_transform=None,
     ):
         self.model = model
         self.mesh = mesh
@@ -81,6 +82,7 @@ class SyncEngine:
         self.loss_fn = get_loss(loss)
         self.compute_dtype = compute_dtype
         self.grad_accum = int(grad_accum)
+        self.device_transform = device_transform
         self._multi_fns = {}
         self._round_fn = self._build_round_fn()
 
@@ -94,6 +96,7 @@ class SyncEngine:
             compute_dtype=self.compute_dtype, grad_transform=sync_grads,
             state_collections=self.model.state_collections,
             grad_accum=self.grad_accum,
+            input_transform=self.device_transform,
         )
 
         m = self.workers_per_chip

@@ -97,9 +97,22 @@ class Trainer:
         on_round=None,
         grad_accum: int = 1,
         transform=None,
+        device_transform=None,
         **kwargs,
     ):
         legacy = {k: kwargs.pop(k) for k in list(kwargs) if k in _LEGACY_SOCKET_KWARGS}
+        if "parallel" in kwargs:
+            # Targeted, not a bare TypeError: a user who learned parallel=
+            # on ADAG will try it on the ensemble/averaging/sync trainers.
+            raise ValueError(
+                f"{type(self).__name__} does not host model-parallel "
+                "submeshes. parallel={'model': tp, 'seq': sp} is supported "
+                "by the communicating async trainers (DOWNPOUR/ADAG/DynSGD/"
+                "AEASGD/EAMSGD — each worker becomes a tp[ x sp] submesh); "
+                "for model-parallel synchronous training use "
+                "ParallelTrainer(parallel={'data': ..., 'model': ...}). "
+                "Averaging/Ensemble fold non-communicating replicas and "
+                "have no submesh variant.")
         if kwargs:
             raise TypeError(
                 f"{type(self).__name__} got unexpected kwargs: {sorted(kwargs)}"
@@ -163,6 +176,12 @@ class Trainer:
         #: works for in-RAM and sharded dataframes alike). See
         #: ``data.batching.apply_round_transform``.
         self.transform = transform
+        #: optional ON-DEVICE per-step transform ``fn(rng, x, y) -> (x, y)``
+        #: applied inside the jitted round program (``ops/augment.py``) —
+        #: image augmentation at VPU cost with raw uint8 staged over PCIe,
+        #: vs ``transform``'s host-numpy cost. Deterministic per
+        #: (seed, round, worker) like the host hook.
+        self.device_transform = device_transform
         self.history: np.ndarray | None = None
         self.worker_histories: np.ndarray | None = None
         self.training_time: float = 0.0
@@ -416,6 +435,7 @@ class SingleTrainer(Trainer):
             self.model, self.worker_optimizer, self.loss, mesh,
             learning_rate=self.learning_rate, compute_dtype=self.compute_dtype,
             seed=self.seed, grad_accum=self.grad_accum,
+            device_transform=self.device_transform,
         )
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,
@@ -468,6 +488,7 @@ class SynchronousDistributedTrainer(DistributedTrainer):
             self.model, self.worker_optimizer, self.loss, mesh,
             learning_rate=self.learning_rate, compute_dtype=self.compute_dtype,
             seed=self.seed, grad_accum=self.grad_accum, workers_per_chip=m,
+            device_transform=self.device_transform,
         )
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,
@@ -507,26 +528,48 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
 
         axes = dict(self.parallel)
         tp = int(axes.pop("model", 1))
+        sp = int(axes.pop("seq", 1))
         if axes:
             raise ValueError(
-                f"async parallel supports only {{'model': n}}, got extra "
-                f"axes {sorted(axes)}; pipeline/seq/expert parallel compose "
-                "via ParallelTrainer instead")
+                f"async parallel supports only {{'model': n}} and "
+                f"{{'seq': s}}, got extra axes {sorted(axes)}; pipeline/"
+                "expert parallel compose via ParallelTrainer instead")
         devices = jax.device_count()
-        W = self.num_workers or devices // tp
-        if W < 1 or W * tp > devices:
+        W = self.num_workers or devices // (tp * sp)
+        if W < 1 or W * tp * sp > devices:
             raise ValueError(
-                f"parallel={{'model': {tp}}} with num_workers={self.num_workers} "
-                f"needs num_workers*{tp} <= {devices} available devices "
-                f"(and at least one worker); got W={W}")
-        mesh = hybrid_mesh({"data": W, "model": tp})
+                f"parallel={{'model': {tp}, 'seq': {sp}}} with "
+                f"num_workers={self.num_workers} needs num_workers*{tp * sp} "
+                f"<= {devices} available devices (and at least one worker); "
+                f"got W={W}")
+        model = self.model
+        layout = {"data": W, "model": tp}
+        if sp > 1 or getattr(model.module, "seq_axis", None) is not None:
+            # seq between data and model: ring ppermutes ride faster links
+            # than the worker fold, TP all-reduces the fastest.
+            layout = {"data": W, "seq": sp, "model": tp}
+        if sp > 1 and getattr(model.module, "seq_axis", None) is None:
+            # Same rebind ParallelTrainer does: a module built without
+            # seq_axis would silently use local positions under sequence
+            # sharding. Dense/flash attention falls back to gather-SP;
+            # 'ring' must be requested at model construction.
+            if not hasattr(model.module, "seq_axis"):
+                raise ValueError(
+                    f"parallel={self.parallel} has a 'seq' axis but "
+                    f"{type(model.module).__name__} is not sequence-"
+                    "shardable (no seq_axis attribute)")
+            from distkeras_tpu.runtime.mesh import SEQ_AXIS
+
+            model = model.with_module(model.module.clone(seq_axis=SEQ_AXIS))
+        mesh = hybrid_mesh(layout)
         rules = self.rules if self.rules is not None else TRANSFORMER_TP_RULES
         return AsyncTPEngine(
-            self.model, self.worker_optimizer, self.loss, self._discipline(),
+            model, self.worker_optimizer, self.loss, self._discipline(),
             mesh, window=self.communication_window, rules=rules,
             learning_rate=self.learning_rate,
             compute_dtype=self.compute_dtype, seed=self.seed,
             grad_accum=self.grad_accum,
+            device_transform=self.device_transform,
         )
 
     def _run(self, dataframe: DataFrame, shuffle: bool):
@@ -541,6 +584,7 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                 learning_rate=self.learning_rate,
                 compute_dtype=self.compute_dtype, seed=self.seed,
                 grad_accum=self.grad_accum, workers_per_chip=m,
+                device_transform=self.device_transform,
             )
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,

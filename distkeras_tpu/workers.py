@@ -32,6 +32,7 @@ def make_local_loop(
     grad_transform: Optional[Callable] = None,
     state_collections: Sequence[str] = (),
     grad_accum: int = 1,
+    input_transform: Optional[Callable] = None,
 ):
     """Build ``local_steps(params, opt_state, xs, ys, rng, state) ->
     (params, opt_state, state, losses)``.
@@ -67,6 +68,14 @@ def make_local_loop(
     unaccumulated step. Mutable state threads through the micro-batches in
     order.
 
+    ``input_transform(rng, x, y) -> (x, y)`` runs ON DEVICE on each step's
+    minibatch before the forward (``ops/augment.py``: jitted crop/flip —
+    augmentation at VPU cost instead of host-numpy cost). It draws a
+    dedicated per-step key from the carried chain (a 3-way split instead of
+    2-way, so a transform-free run's rng stream is untouched when the hook
+    is None; enabling it yields a different — equally deterministic —
+    stream).
+
     The rng handed in must be identical across replicas if determinism across
     restarts matters; per-step dropout keys are derived inside the scan.
     """
@@ -79,6 +88,17 @@ def make_local_loop(
             return x.astype(compute_dtype)
         return x
 
+    def cast_input(x):
+        if x.dtype == jnp.uint8:
+            # Raw image bytes: normalize to the compute dtype ON DEVICE.
+            # Shipping uint8 and dividing in-graph is 4x less host->device
+            # traffic than staging float32 — the difference between a feed-
+            # bound and a compute-bound out-of-core run (docs/PERFORMANCE.md
+            # "Feed overlap"). Unambiguous: integer token/label inputs are
+            # int32/int64, never uint8.
+            return x.astype(compute_dtype or jnp.float32) / 255.0
+        return cast(x)
+
     def loss_on_batch(params, state, x, y, rng):
         if compute_dtype is not None:
             params = jax.tree.map(cast, params)
@@ -86,12 +106,12 @@ def make_local_loop(
         # for any module that samples (flax raises at trace time otherwise).
         if cols:
             out, mut = module.apply(
-                {"params": params, **state}, cast(x), train=True,
+                {"params": params, **state}, cast_input(x), train=True,
                 rngs={"dropout": rng}, mutable=list(cols),
             )
             new_state = {k: mut[k] for k in cols}
             return loss_fn(out.astype(jnp.float32), y), new_state
-        out = module.apply({"params": params}, cast(x), train=True, rngs={"dropout": rng})
+        out = module.apply({"params": params}, cast_input(x), train=True, rngs={"dropout": rng})
         return loss_fn(out.astype(jnp.float32), y), state
 
     def local_steps(params, opt_state, xs, ys, rng: Optional[jax.Array] = None,
@@ -126,8 +146,12 @@ def make_local_loop(
 
         def step(carry, batch):
             p, s, st, key = carry
-            key, sub = jax.random.split(key)
             x, y = batch
+            if input_transform is not None:
+                key, sub, akey = jax.random.split(key, 3)
+                x, y = input_transform(akey, x, y)
+            else:
+                key, sub = jax.random.split(key)
             loss, st, grads = grad_of_step(p, st, x, y, sub)
             if grad_transform is not None:
                 grads, loss = grad_transform(grads, loss)

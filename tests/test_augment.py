@@ -1,0 +1,141 @@
+"""On-device augmentation (``ops/augment.py`` + ``device_transform=``).
+
+The host ``transform=`` hook's jitted sibling: crop/flip runs inside the
+round program, so out-of-core image pipelines stage raw uint8 and the chip
+does the rest (docs/PERFORMANCE.md "Feed overlap").
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu.ops.augment import flip_crop_transform, random_flip_crop
+
+
+def _images(n=8, hw=16, dtype=np.uint8, seed=0):
+    rng = np.random.default_rng(seed)
+    if dtype == np.uint8:
+        return rng.integers(0, 256, size=(n, hw, hw, 3)).astype(np.uint8)
+    return rng.random((n, hw, hw, 3)).astype(dtype)
+
+
+def test_random_flip_crop_shapes_dtype_and_determinism():
+    x = jnp.asarray(_images())
+    k = jax.random.key(0)
+    out1 = random_flip_crop(k, x)
+    out2 = random_flip_crop(k, x)
+    assert out1.shape == x.shape and out1.dtype == x.dtype
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    # A different key gives a different augmentation.
+    out3 = random_flip_crop(jax.random.key(1), x)
+    assert not np.array_equal(np.asarray(out1), np.asarray(out3))
+
+
+def test_random_flip_crop_content_is_a_crop_of_pad_or_flip():
+    """Every output row must equal SOME (flip, y, x) crop of its input row —
+    the transform can distort nothing, only translate/mirror."""
+    x = _images(n=4, hw=8)
+    out = np.asarray(random_flip_crop(jax.random.key(3), jnp.asarray(x)))
+    pad = 4
+    for i in range(len(x)):
+        candidates = []
+        for flip in (False, True):
+            img = x[i, :, ::-1] if flip else x[i]
+            padded = np.pad(img, ((pad, pad), (pad, pad), (0, 0)),
+                            mode="reflect")
+            for yy in range(2 * pad + 1):
+                for xx in range(2 * pad + 1):
+                    candidates.append(padded[yy:yy + 8, xx:xx + 8])
+        assert any(np.array_equal(out[i], c) for c in candidates), i
+
+
+def test_device_transform_trains_from_uint8_store():
+    """End-to-end: uint8 features + device_transform crop/flip + in-graph
+    /255 normalization under both engines; finite decreasing loss."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.cnn import SimpleCNN
+
+    rng = np.random.default_rng(0)
+    n, hw, c = 256, 16, 3
+    y = rng.integers(0, c, size=n).astype(np.int32)
+    # Class-dependent brightness so the tiny CNN can learn from uint8.
+    x = (rng.integers(0, 60, size=(n, hw, hw, 3))
+         + y[:, None, None, None] * 80).clip(0, 255).astype(np.uint8)
+    df = dk.DataFrame({"features": x, "label": y})
+    model = Model.build(SimpleCNN(conv_features=(8,), dense=(16,),
+                                  num_outputs=c),
+                        jnp.zeros((1, hw, hw, 3), jnp.float32))
+    from distkeras_tpu.ops.augment import flip_crop_transform as fct
+
+    for make in (
+        lambda: dk.SynchronousDistributedTrainer(
+            model, loss="sparse_categorical_crossentropy", num_workers=2,
+            batch_size=8, num_epoch=2, learning_rate=0.05,
+            steps_per_program=2, device_transform=fct(pad=2)),
+        lambda: dk.ADAG(
+            model, loss="sparse_categorical_crossentropy", num_workers=2,
+            batch_size=8, num_epoch=2, learning_rate=0.05,
+            communication_window=2, device_transform=fct(pad=2)),
+    ):
+        t = make()
+        t.train(df)
+        h = t.get_history()
+        assert np.isfinite(h).all()
+        assert h[-1] < h[0], h
+
+
+def test_uint8_predict_matches_float_predict():
+    """Train/inference parity for raw-byte stores: Model.predict and
+    ModelPredictor on uint8 features == the same features pre-divided by
+    255 — the skew guard for the uint8 rule in make_local_loop."""
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.predictors import ModelPredictor
+    import distkeras_tpu as dk
+
+    rng = np.random.default_rng(0)
+    x8 = rng.integers(0, 256, size=(16, 8)).astype(np.uint8)
+    xf = x8.astype(np.float32) / 255.0
+    model = Model.build(MLP(hidden=(8,), num_outputs=3),
+                        jnp.zeros((1, 8), jnp.float32))
+    np.testing.assert_allclose(
+        np.asarray(model.predict(jnp.asarray(x8))),
+        np.asarray(model.predict(jnp.asarray(xf))), rtol=1e-6)
+    out8 = ModelPredictor(model).predict(dk.DataFrame({"features": x8}))
+    outf = ModelPredictor(model).predict(dk.DataFrame({"features": xf}))
+    np.testing.assert_allclose(np.asarray(out8["prediction"]),
+                               np.asarray(outf["prediction"]), rtol=1e-6)
+
+
+def test_uint8_features_normalized_in_graph():
+    """make_local_loop's uint8 rule: a uint8 batch trains identically to
+    the same batch pre-divided by 255 as float32."""
+    import optax
+
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.workers import make_local_loop
+
+    rng = np.random.default_rng(0)
+    x8 = rng.integers(0, 256, size=(2, 4, 8)).astype(np.uint8)
+    xf = x8.astype(np.float32) / 255.0
+    y = rng.integers(0, 3, size=(2, 4)).astype(np.int32)
+    model = Model.build(MLP(hidden=(8,), num_outputs=3),
+                        jnp.zeros((1, 8), jnp.float32))
+    from distkeras_tpu.ops.losses import get_loss
+
+    tx = optax.sgd(0.1)
+    loop = make_local_loop(model.module,
+                           get_loss("sparse_categorical_crossentropy"), tx)
+    opt = tx.init(model.params)
+    p_a, _, _, loss_a = loop(model.params, opt, jnp.asarray(x8),
+                             jnp.asarray(y), jax.random.key(0), None)
+    p_b, _, _, loss_b = loop(model.params, opt, jnp.asarray(xf),
+                             jnp.asarray(y), jax.random.key(0), None)
+    np.testing.assert_allclose(np.asarray(loss_a), np.asarray(loss_b),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(p_a), jax.tree.leaves(p_b)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)

@@ -1,0 +1,116 @@
+"""Feed-overlap instrumentation + staging-overlap regression guards
+(VERDICT r4 missing #3).
+
+Two layers: (a) RoundFeeder's lookahead genuinely overlaps staging with
+consumption (deterministic sleep-based timing — if someone serializes the
+feeder, wall time doubles and this fails); (b) the engine run loops expose
+``feed_wait_seconds``/``feed_waits`` — the always-on consumer-block
+diagnostic docs/PERFORMANCE.md's "Feed overlap" section measures in anger
+on the real chip via ``examples/imagenet_disk.py --measure-feed``.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distkeras_tpu.data.prefetch import RoundFeeder
+
+
+def test_round_feeder_overlaps_staging_with_consumption():
+    """10 rounds of 30 ms staging against 30 ms consumption must take
+    ~max(stage, consume) per round, not their sum — and the recorded
+    consumer waits past the warmup round must be near zero."""
+    stage_s, consume_s, rounds = 0.03, 0.03, 10
+
+    def stage(r):
+        time.sleep(stage_s)
+        return r
+
+    feeder = RoundFeeder(rounds, stage)
+    t0 = time.perf_counter()
+    seen = []
+    for r, batch in feeder:
+        time.sleep(consume_s)
+        seen.append(r)
+    wall = time.perf_counter() - t0
+    assert seen == list(range(rounds))
+    serialized = rounds * (stage_s + consume_s)
+    # Generous bound (CI jitter): must be clearly below full serialization.
+    assert wall < serialized * 0.8, (
+        f"feeder serialized: wall {wall:.3f}s vs serialized {serialized:.3f}s")
+    assert len(feeder.waits) == rounds
+    # Past the first round the feeder's lookahead has the next batch staged
+    # before the consumer asks for it.
+    assert sum(feeder.waits[1:]) < rounds * stage_s * 0.5, feeder.waits
+
+
+def test_round_feeder_reports_stall_when_staging_dominates():
+    """The inverse: staging 3x slower than consumption must SHOW in the
+    waits — the diagnostic must not hide a feed-bound pipeline."""
+    feeder = RoundFeeder(6, lambda r: time.sleep(0.03) or r)
+    for r, _ in feeder:
+        time.sleep(0.01)
+    # Consumer blocked roughly (stage - consume) per round after warmup.
+    assert sum(feeder.waits[1:]) > 0.03, feeder.waits
+
+
+def test_engine_exposes_feed_wait_metric():
+    """Every engine run attaches the feed diagnostic (per-round + sum)."""
+    from distkeras_tpu.data.batching import make_batches
+    from distkeras_tpu.data.dataframe import DataFrame
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.parallel.sync import SyncEngine
+    from distkeras_tpu.runtime.mesh import data_mesh
+
+    rng = np.random.default_rng(0)
+    df = DataFrame({"features": rng.normal(size=(256, 8)).astype(np.float32),
+                    "label": rng.integers(0, 3, size=256).astype(np.int32)})
+    model = Model.build(MLP(hidden=(8,), num_outputs=3),
+                        jnp.zeros((1, 8), jnp.float32))
+    engine = SyncEngine(model, "sgd", "sparse_categorical_crossentropy",
+                        data_mesh(num_workers=2), learning_rate=0.05)
+    plan = make_batches(df, "features", "label", batch_size=8,
+                        num_workers=2, window=4, num_epoch=1)
+    for rpp in (1, 2):  # per-round and blocked paths both instrument
+        engine.run(plan, rounds_per_program=rpp)
+        assert np.isfinite(engine.feed_wait_seconds)
+        assert len(engine.feed_waits) >= 1
+        assert all(w >= 0 for w in engine.feed_waits)
+
+
+@pytest.mark.slow
+def test_augmented_outofcore_feed_smoke(tmp_path):
+    """The measured path end-to-end at CPU scale: uint8 virtual store +
+    crop/flip transform through measure_feed — the JSON record must carry
+    all protocol fields and a sane hidden fraction."""
+    import importlib.util
+    import os
+    import sys
+
+    spec = importlib.util.spec_from_file_location(
+        "imagenet_disk", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "examples", "imagenet_disk.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["imagenet_disk"] = mod
+    spec.loader.exec_module(mod)
+
+    import distkeras_tpu as dk
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.resnet import ResNet
+
+    root = str(tmp_path / "store")
+    mod.build_virtual_store(root, 0.004, 32, classes=10, dtype="uint8")
+    sdf = dk.ShardedDataFrame(root)
+    model = Model.build(
+        ResNet(stage_sizes=(1, 1), base_features=8, num_outputs=10, groups=4),
+        np.zeros((1, 32, 32, 3), np.float32), seed=0)
+    rec = mod.measure_feed(sdf, model, batch_size=16, window=2)
+    assert 0.0 <= rec["value"] <= 1.0
+    assert rec["rounds"] >= 2
+    assert rec["stage_per_round_ms"] > 0
+    assert len(rec["feed_waits_ms"]) == rec["rounds"]
