@@ -76,6 +76,21 @@ def merge_manifests(path: str) -> dict:
         with open(journal_path) as f:
             plan = json.load(f)  # resume an interrupted merge
     else:
+        # A FRESH merge must target a fresh directory: the rename plan starts
+        # at global shard 0, so a root that already holds a published store
+        # (earlier merge / direct ShardWriter run) would have its shard files
+        # silently clobbered. The journal only protects the CURRENT merge
+        # against crashes, not against this misuse.
+        existing = [f for f in os.listdir(path)
+                    if f == _MANIFEST
+                    or (f.startswith("shard-") and f.endswith(".npy"))]
+        if existing:
+            raise FileExistsError(
+                f"{path} already contains a published store "
+                f"({existing[0]}{' ...' if len(existing) > 1 else ''}): "
+                "merging part-*/ directories here would overwrite its "
+                "shard files from global id 0. Ingest parts into a fresh "
+                "directory, or remove the existing store first.")
         parts = sorted(d for d in os.listdir(path)
                        if d.startswith("part-")
                        and os.path.isdir(os.path.join(path, d)))

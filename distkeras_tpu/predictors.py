@@ -28,6 +28,21 @@ class Predictor:
         raise NotImplementedError
 
 
+def _unlink_column_files(path: str, physical: str, num_shards: int) -> None:
+    """Best-effort removal of a superseded physical column's shard files.
+
+    Missing files are fine (another process's disk, or already cleaned);
+    memmapped readers holding the old manifest survive the unlink (POSIX)."""
+    import contextlib
+    import os
+
+    from distkeras_tpu.data.shards import _shard_file
+
+    for s in range(num_shards):
+        with contextlib.suppress(OSError):
+            os.remove(os.path.join(path, _shard_file(s, physical)))
+
+
 class ModelPredictor(Predictor):
     """Append ``output_col`` with the model's raw outputs (logits).
 
@@ -59,9 +74,12 @@ class ModelPredictor(Predictor):
         # addressable on every process (multi-host predict works; one small
         # all-gather per chunk otherwise fused away single-process).
         state = self.model.state or {}
+        from distkeras_tpu.models.base import normalize_features
+
         self._fwd = jax.jit(
             lambda params, state, x: self.model.module.apply(
-                {"params": params, **state}, x, train=False),
+                {"params": params, **state}, normalize_features(x),
+                train=False),
             out_shardings=rep,
         )
         self._params = put_global(self.model.params, rep)
@@ -221,12 +239,18 @@ class ModelPredictor(Predictor):
         # truth for which files a column reads — swaps atomically at the
         # end: a crash mid-stream leaves any pre-existing column fully
         # intact (no per-shard renames over live files, which could mix two
-        # models' outputs). Superseded versions' files are orphaned, not
-        # deleted (readers of the old manifest may still hold them).
+        # models' outputs). The superseded version's files are deleted after
+        # the swap (memmapped readers of the old manifest survive the
+        # unlink on POSIX; without the cleanup every re-predict leaks one
+        # full column of shard files).
         import uuid
 
         physical = self.output_col
+        old_physical = None
         if self.output_col in store.columns:
+            old = store.columns[self.output_col]
+            old_physical = (old.get("file", self.output_col)
+                            if isinstance(old, dict) else self.output_col)
             physical = f"{self.output_col}.{uuid.uuid4().hex[:8]}"
         meta: dict = {}
         source = (chunk[self.features_col]
@@ -245,23 +269,85 @@ class ModelPredictor(Predictor):
         with open(tmp, "w") as f:
             json.dump(manifest, f)
         os.replace(tmp, os.path.join(store.path, "manifest.json"))
+        if old_physical is not None:
+            _unlink_column_files(store.path, old_physical, store.num_shards)
         return ShardedDataFrame(ShardStore.open(store.path),
                                 num_partitions=sdf.num_partitions)
+
+    def _shard_assignment(self, store, nproc: int, pid: int) -> list[int]:
+        """Which global shards THIS process predicts — residency-aware.
+
+        The training plane's locality contract is per-host shard residency:
+        a host may hold only the shard files overlapping its own workers'
+        rows (``shards.py`` module docstring). A contiguous index-range
+        split would ask hosts for shards they don't hold and die on
+        FileNotFoundError. Instead each process reports which feature
+        shards are present on ITS disk, the bitmaps are all-gathered, and:
+
+        * every process holds everything (shared filesystem) -> balanced
+          contiguous ranges (the throughput-optimal split);
+        * disjoint/partial residency -> shard ``s`` goes to its
+          ``s % n_holders``-th holder (deterministic from the gathered
+          bitmap, no extra coordination; round-robin so mirrored-but-
+          incomplete disks still split the work instead of piling every
+          shared shard on the lowest pid);
+        * a shard nobody holds -> a contract error naming the missing
+          shards, not a FileNotFoundError mid-stream.
+        """
+        import os
+
+        from jax.experimental import multihost_utils
+
+        from distkeras_tpu.data.shards import _shard_file
+
+        S = store.num_shards
+        fcol = store.columns.get(self.features_col, {})
+        physical_feat = (fcol.get("file", self.features_col)
+                         if isinstance(fcol, dict) else self.features_col)
+        present = np.array(
+            [os.path.exists(os.path.join(
+                store.path, _shard_file(s, physical_feat)))
+             for s in range(S)], dtype=np.int32)
+        held = np.asarray(multihost_utils.process_allgather(present))
+        held = held.reshape(nproc, S)
+        if held.all():  # shared FS: balanced contiguous split
+            return list(range(pid * S // nproc, (pid + 1) * S // nproc))
+        orphans = np.flatnonzero(held.sum(axis=0) == 0)
+        if orphans.size:
+            raise ValueError(
+                f"sharded predict residency contract violated: feature "
+                f"shards {orphans.tolist()} of column "
+                f"{self.features_col!r} are present on NO process's disk. "
+                "Multi-process predict runs where the data lives — every "
+                "shard must be held by at least one process (or use a "
+                "shared filesystem).")
+        mine = []
+        for s in range(S):
+            holders = np.flatnonzero(held[:, s])
+            if holders[s % len(holders)] == pid:
+                mine.append(s)
+        return mine
 
     def _predict_sharded_multiprocess(self, sdf):
         """Multi-host out-of-core inference (the reference's map-partitions
         predict was inherently multi-executor, SURVEY.md §3.5).
 
-        Each process takes a disjoint contiguous SHARD range and runs a
-        PROCESS-LOCAL forward over its own devices — no collective in the
-        per-chunk program, so mismatched per-host chunk counts cannot
-        deadlock. Output shard files keep the global shard ids (1:1 with the
-        feature shards a process read). The column spec is derived
-        abstractly (``_empty_block``: eval_shape + postprocess), so every
-        process — including one that owned zero shards — computes the
-        identical manifest and commits it atomically after a global barrier
-        (per-process tmp + rename, the checkpoint-meta-sidecar pattern:
-        valid on a shared filesystem AND on per-host local disks)."""
+        Each process takes a disjoint set of shards — the shards its own
+        disk holds (:meth:`_shard_assignment`; balanced contiguous ranges on
+        a shared filesystem) — and runs a PROCESS-LOCAL forward over its own
+        devices: no collective in the per-chunk program, so mismatched
+        per-host chunk counts cannot deadlock. Output shard files keep the
+        global shard ids (1:1 with the feature shards a process read, so
+        predictions land beside their features — same host). The column
+        spec is derived abstractly (``_empty_block``: eval_shape +
+        postprocess), so every process — including one that owned zero
+        shards — computes the identical manifest and commits it atomically
+        after a global barrier (per-process tmp + rename, the
+        checkpoint-meta-sidecar pattern: valid on a shared filesystem AND on
+        per-host local disks). Re-predicting an existing column writes a
+        fresh versioned physical column; after the publish barrier each
+        process deletes the superseded version's files for its shards
+        (memmapped readers of the old manifest survive the unlink on POSIX)."""
         import json
         import os
         import uuid
@@ -275,13 +361,16 @@ class ModelPredictor(Predictor):
         if store.count() == 0:
             raise ValueError(f"store {store.path} has no rows to predict")
         nproc, pid = jax.process_count(), jax.process_index()
-        S = store.num_shards
-        lo, hi = pid * S // nproc, (pid + 1) * S // nproc
+        my_shards = self._shard_assignment(store, nproc, pid)
 
         # Fresh versioned physical name when overwriting an existing column —
         # agreed across processes (process 0's draw is broadcast).
         physical = self.output_col
+        old_physical = None
         if self.output_col in store.columns:
+            old = store.columns[self.output_col]
+            old_physical = (old.get("file", self.output_col)
+                            if isinstance(old, dict) else self.output_col)
             tag = multihost_utils.broadcast_one_to_all(
                 np.frombuffer(uuid.uuid4().bytes[:8], dtype=np.uint8))
             physical = f"{self.output_col}.{bytes(bytearray(tag)).hex()[:8]}"
@@ -289,11 +378,9 @@ class ModelPredictor(Predictor):
         local = type(self)(self.model, self.features_col, self.output_col,
                            chunk_size=self.chunk_size,
                            devices=jax.local_devices())
-        source = (store.read_shard(s, self.features_col)
-                  for s in range(lo, hi))
-        for i, out in enumerate(local.predict_stream(source)):
-            np.save(os.path.join(store.path, _shard_file(lo + i, physical)),
-                    out)
+        source = (store.read_shard(s, self.features_col) for s in my_shards)
+        for s, out in zip(my_shards, local.predict_stream(source)):
+            np.save(os.path.join(store.path, _shard_file(s, physical)), out)
 
         # Deterministic column spec, independent of owning any shards.
         fshape, fdtype = store.column_spec(self.features_col)
@@ -311,6 +398,11 @@ class ModelPredictor(Predictor):
             json.dump(manifest, f)
         os.replace(tmp, os.path.join(store.path, "manifest.json"))
         multihost_utils.sync_global_devices("dk_sharded_predict_published")
+        if old_physical is not None:
+            # The new manifest is live everywhere: reclaim the superseded
+            # physical column (one full column of shard files per re-predict
+            # otherwise). Each process cleans what its disk holds.
+            _unlink_column_files(store.path, old_physical, store.num_shards)
         return ShardedDataFrame(ShardStore.open(store.path),
                                 num_partitions=sdf.num_partitions)
 

@@ -36,17 +36,24 @@ class RacedParameterServer:
     """The reference's server half: lock + fold, commit-order = thread race.
 
     ``discipline``: 'downpour' (center += delta), 'adag' (center += delta/K,
-    the worker pre-normalizes), or 'dynsgd' (center += delta/(staleness+1)).
+    the worker pre-normalizes), 'dynsgd' (center += delta/(staleness+1)), or
+    'aeasgd'/'eamsgd' (center += elastic difference — the reference routed
+    both elastic trainers through the plain ``DeltaParameterServer``; all
+    elasticity lives on the worker side, SURVEY.md §3.3).
     """
 
     def __init__(self, center: Sequence[np.ndarray], discipline: str = "adag"):
-        if discipline not in ("downpour", "adag", "dynsgd"):
+        if discipline not in ("downpour", "adag", "dynsgd", "aeasgd",
+                              "eamsgd"):
             raise ValueError(f"unsupported raced discipline {discipline!r}")
         self._lock = threading.Lock()
         self._center = [np.array(a, np.float32) for a in center]
         self._updates = 0  # server update counter (DynSGD staleness basis)
         self.discipline = discipline
-        self.commit_log: list[int] = []  # staleness of each commit, in order
+        #: realized staleness of each commit, in commit order (recorded for
+        #: EVERY discipline — the race-happened evidence; only dynsgd also
+        #: *scales* by it).
+        self.commit_log: list[int] = []
 
     def pull(self) -> tuple[list[np.ndarray], int]:
         with self._lock:
@@ -54,11 +61,11 @@ class RacedParameterServer:
 
     def commit(self, delta: Sequence[np.ndarray], pulled_counter: int) -> None:
         with self._lock:
+            staleness = self._updates - pulled_counter
+            self.commit_log.append(staleness)
             scale = 1.0
             if self.discipline == "dynsgd":
-                staleness = self._updates - pulled_counter
                 scale = 1.0 / (staleness + 1.0)
-                self.commit_log.append(staleness)
             for c, d in zip(self._center, delta):
                 c += scale * np.asarray(d, np.float32)
             self._updates += 1
@@ -76,13 +83,27 @@ def run_raced(
     window: int,
     discipline: str = "adag",
     overlap_first_round: bool = False,
+    alpha: float = 0.05,
 ) -> tuple[list[np.ndarray], RacedParameterServer]:
     """Race ``len(worker_batches)`` threads against one server.
 
     ``local_steps(params_list, batch) -> params_list`` runs the K-step local
     window (jitted JAX; must be thread-safe, which jitted functions are).
-    ``worker_batches[w]`` is worker w's sequence of per-round batches — its
-    Spark-partition analogue; one commit per batch.
+    For 'eamsgd' the callable may carry per-worker auxiliary state (momentum
+    velocities): ``local_steps(params_list, batch, aux) -> (params_list,
+    aux)`` with ``aux=None`` on the first round. ``worker_batches[w]`` is
+    worker w's sequence of per-round batches — its Spark-partition analogue;
+    one commit per batch.
+
+    Elastic disciplines ('aeasgd'/'eamsgd') run the reference's §3.3 worker
+    loop: the local replica PERSISTS across rounds (exploration is the
+    point); each round the worker pulls the center, runs K local steps from
+    its own replica, computes ``e = alpha*(w_local − center_pulled)``,
+    moves itself ``w_local −= e``, and commits ``e`` (server: center += e).
+    Because the pull and the commit bracket the K-step window with no lock
+    held, other workers' elastic terms land in between — the commit is
+    computed against a genuinely stale center, which is exactly the raced
+    interleaving the window-K fold serializes.
 
     ``overlap_first_round`` holds every worker at a barrier after its first
     pull, guaranteeing the first W commits race (staleness 0..W-1 realized
@@ -90,25 +111,40 @@ def run_raced(
     serialize the threads. Later rounds race freely either way.
 
     Returns the final center and the server (whose ``commit_log`` shows the
-    realized staleness distribution for dynsgd).
+    realized staleness distribution).
     """
     ps = RacedParameterServer(center, discipline)
     errors: list[BaseException] = []
+    elastic = discipline in ("aeasgd", "eamsgd")
+    stateful = discipline == "eamsgd"
     gate = (threading.Barrier(len(worker_batches))
             if overlap_first_round else None)
 
     def work(w: int) -> None:
         try:
+            local = [np.array(a, np.float32) for a in center] if elastic else None
+            aux = None
             for r, batch in enumerate(worker_batches[w]):
                 pulled, counter = ps.pull()
                 if gate is not None and r == 0:
                     gate.wait()
-                new = local_steps(pulled, batch)
-                delta = [np.asarray(n, np.float32) - p
+                start = local if elastic else pulled
+                if stateful:
+                    new, aux = local_steps(start, batch, aux)
+                else:
+                    new = local_steps(start, batch)
+                if elastic:
+                    e = [alpha * (np.asarray(n, np.float32) - p)
                          for n, p in zip(new, pulled)]
-                if discipline == "adag":
-                    delta = [d / float(window) for d in delta]
-                ps.commit(delta, counter)
+                    local = [np.asarray(n, np.float32) - d
+                             for n, d in zip(new, e)]
+                    ps.commit(e, counter)
+                else:
+                    delta = [np.asarray(n, np.float32) - p
+                             for n, p in zip(new, pulled)]
+                    if discipline == "adag":
+                        delta = [d / float(window) for d in delta]
+                    ps.commit(delta, counter)
         except BaseException as e:  # noqa: BLE001 - surface on the main thread
             errors.append(e)
 

@@ -1,0 +1,87 @@
+"""Worker for the residency-aware multi-process sharded-predict test.
+
+Each process opens a PER-PROCESS store directory (``$DK_OUT/store_p<i>``) that
+holds the full manifest but ONLY the shard files its "host disk" owns — the
+training plane's per-host residency contract (``shards.py`` module
+docstring). The predict split must follow what each disk actually holds
+(round-robin among each shard's holders — the unique holder when residency
+is disjoint), write predictions beside their features, and
+the union across processes must equal the single-process reference the
+parent computes. A second store pair with one shard missing from EVERY disk
+must produce the documented contract error, not a FileNotFoundError.
+
+Run only via ``tests/test_multihost.py``.
+"""
+
+import json
+import os
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main() -> None:
+    from distkeras_tpu.data.shards import ShardedDataFrame, _shard_file
+    from distkeras_tpu.models import Model
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.predictors import ClassPredictor
+    from distkeras_tpu.runtime.mesh import distributed_initialize
+
+    distributed_initialize(
+        coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+        num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+        process_id=int(os.environ["JAX_PROCESS_ID"]),
+    )
+    pid = jax.process_index()
+    out = os.environ["DK_OUT"]
+    store_dir = os.path.join(out, f"store_p{pid}")
+
+    n, d, c = 512, 4, 3
+    model = Model.build(MLP(hidden=(16,), num_outputs=c),
+                        np.zeros((1, d), np.float32), seed=0)
+
+    sdf = ShardedDataFrame(store_dir)
+    res = ClassPredictor(model, output_col="pred", chunk_size=64).predict(sdf)
+    store = res.store
+
+    # Read back ONLY what this disk holds: predictions must sit beside their
+    # features (same global shard ids, this directory).
+    pred_file = store.columns["pred"].get("file", "pred")
+    local_shards = [
+        s for s in range(store.num_shards)
+        if os.path.exists(os.path.join(store_dir, _shard_file(s, "features")))
+    ]
+    local_pred_shards = [
+        s for s in range(store.num_shards)
+        if os.path.exists(os.path.join(store_dir, _shard_file(s, pred_file)))
+    ]
+    preds = {str(s): np.load(os.path.join(
+        store_dir, _shard_file(s, pred_file))).tolist()
+        for s in local_pred_shards}
+
+    # Orphaned-shard contract error (store with a shard on NO disk).
+    orphan_error = ""
+    try:
+        ClassPredictor(model, output_col="pred", chunk_size=64).predict(
+            ShardedDataFrame(os.path.join(out, f"orphan_p{pid}")))
+    except ValueError as e:
+        orphan_error = str(e)
+
+    with open(os.path.join(out, f"proc{pid}.json"), "w") as f:
+        json.dump({
+            "process": pid,
+            "local_feature_shards": local_shards,
+            "local_pred_shards": local_pred_shards,
+            "preds": preds,
+            "pred_file": pred_file,
+            "orphan_error": orphan_error,
+        }, f)
+
+
+if __name__ == "__main__":
+    main()

