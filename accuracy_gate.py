@@ -1,0 +1,154 @@
+"""North-star accuracy leg (BASELINE.md #3; VERDICT r4 missing #2).
+
+The gate demands >= 90 % linear scaling *at ADAG-equivalent final accuracy*.
+The scaling half is bounded analytically and test-pinned
+(``tests/test_scaling_model.py``); THIS script closes the accuracy half on
+the gate's own model: the bench CIFAR-10 CNN (``models/cnn.py::cifar10_cnn``)
+trained to convergence under **ADAG**, **AEASGD** (the north-star
+discipline), and **sync-DP**, with matched sample budgets, at the bench
+topology (W=8 logical workers multiplexed on one chip, window 8, global
+batch 1024), across >= 3 seeds — final held-out accuracy must agree within
+epsilon. One chip suffices: this is an accuracy claim, not a scaling claim.
+
+Writes ``ACCURACY_r05.json`` (the committed artifact) and prints it. The
+CIFAR-10 source is ``datasets.cifar10``: real data when present in
+``--data-dir``, otherwise the structured synthetic stand-in — flagged in
+the artifact via ``synthetic`` (this build environment has no egress;
+BASELINE.md's provenance rules apply).
+
+A CPU-sized twin of the same comparison is pinned in
+``tests/test_accuracy_gate.py``.
+
+    PYTHONPATH=.:/root/.axon_site python accuracy_gate.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+
+EPSILON = 0.02  # max allowed |acc(discipline) - acc(ADAG)| on seed means
+
+
+def run_gate(seeds=(0, 1, 2), n_train=40960, n_eval=8192, num_workers=8,
+             window=8, batch_size=128, num_epoch=3, learning_rate=0.05,
+             data_dir=None):
+    import jax
+    import jax.numpy as jnp
+
+    import distkeras_tpu as dk
+    from distkeras_tpu.datasets import cifar10
+    from distkeras_tpu.models.cnn import cifar10_cnn
+
+    df_all = cifar10(n=n_train + n_eval, data_dir=data_dir)
+    x = np.asarray(df_all["features"])
+    y = np.asarray(df_all["label"])
+    # Fixed split; shuffle before so synthetic class structure can't align
+    # with the worker-contiguous partitioning.
+    perm = np.random.default_rng(123).permutation(len(x))
+    x, y = x[perm], y[perm]
+    train = dk.DataFrame({"features": x[:n_train], "label": y[:n_train]})
+    te_x, te_y = x[n_train:], y[n_train:]
+
+    common = dict(loss="sparse_categorical_crossentropy",
+                  num_workers=num_workers, batch_size=batch_size,
+                  num_epoch=num_epoch, learning_rate=learning_rate,
+                  compute_dtype="bfloat16")
+
+    def make(disc, model, seed):
+        if disc == "adag":
+            return dk.ADAG(model, communication_window=window, seed=seed,
+                           **common)
+        if disc == "aeasgd":
+            # Elastic rate: the center fold adds SUM_w alpha*(w - center),
+            # so stability needs W*alpha < 1 (Zhang et al.'s beta = W*alpha
+            # = 0.4 sizing). rho = alpha/lr -> alpha = 0.05, W*alpha = 0.4.
+            return dk.AEASGD(model, communication_window=window, seed=seed,
+                             rho=0.05 / learning_rate, **common)
+        if disc == "sync":
+            return dk.SynchronousDistributedTrainer(
+                model, steps_per_program=window, seed=seed, **common)
+        raise KeyError(disc)
+
+    def accuracy(model):
+        preds = []
+        for s in range(0, len(te_x), 2048):
+            preds.append(np.asarray(
+                model.predict(jnp.asarray(te_x[s:s + 2048]))).argmax(-1))
+        return float((np.concatenate(preds) == te_y).mean())
+
+    out: dict = {
+        "metric": "cifar10_cnn_final_accuracy_gap_aeasgd_vs_adag",
+        "unit": "abs difference of seed-mean held-out accuracy",
+        "epsilon": EPSILON,
+        "synthetic": bool(getattr(df_all, "synthetic", True)),
+        "config": {"num_workers": num_workers, "window": window,
+                   "batch_size_per_worker": batch_size,
+                   "global_batch": batch_size * num_workers,
+                   "num_epoch": num_epoch, "learning_rate": learning_rate,
+                   "n_train": n_train, "n_eval": n_eval,
+                   "samples_budget": n_train * num_epoch,
+                   "seeds": list(seeds),
+                   "model": "cifar10_cnn (bench config #3 architecture)"},
+        "disciplines": {},
+    }
+    for disc in ("adag", "aeasgd", "sync"):
+        accs, losses = [], []
+        for seed in seeds:
+            t0 = time.perf_counter()
+            trainer = make(disc, cifar10_cnn(seed=seed), seed)
+            trained = trainer.train(train, shuffle=True)
+            accs.append(accuracy(trained))
+            h = trainer.get_history()
+            losses.append([float(h[0]), float(h[-1])])
+            print(f"[gate] {disc} seed {seed}: acc {accs[-1]:.4f} "
+                  f"loss {h[0]:.3f}->{h[-1]:.3f} "
+                  f"({time.perf_counter() - t0:.0f}s)", flush=True)
+        out["disciplines"][disc] = {
+            "accuracies": [round(a, 4) for a in accs],
+            "mean": round(float(np.mean(accs)), 4),
+            "std": round(float(np.std(accs)), 4),
+            "loss_first_last": losses,
+        }
+    adag = out["disciplines"]["adag"]["mean"]
+    out["gaps_vs_adag"] = {
+        d: round(abs(out["disciplines"][d]["mean"] - adag), 4)
+        for d in ("aeasgd", "sync")}
+    out["value"] = out["gaps_vs_adag"]["aeasgd"]
+    out["passes"] = bool(out["value"] < EPSILON)
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-dir", default=os.environ.get("CIFAR10_DIR"))
+    p.add_argument("--out", default="ACCURACY_r05.json")
+    args = p.parse_args()
+    rec = run_gate(data_dir=args.data_dir)
+    # The synthetic stand-in saturates at matched full budgets (every
+    # discipline -> 1.0), which makes the epsilon comparison vacuous. A
+    # budget-starved twin (1/10 the samples, 1 epoch) stops short of
+    # saturation, so the disciplines' PARTIAL-convergence accuracies have
+    # to agree too — a strictly harder equivalence.
+    low = run_gate(n_train=8192, n_eval=4096, num_epoch=1, batch_size=32,
+                   data_dir=args.data_dir)
+    rec["low_budget"] = {
+        "config": low["config"],
+        "disciplines": low["disciplines"],
+        "gaps_vs_adag": low["gaps_vs_adag"],
+        "passes": low["passes"],
+    }
+    with open(args.out, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps({k: rec[k] for k in
+                      ("metric", "value", "epsilon", "passes", "synthetic")}
+                     | {"low_budget_gaps": low["gaps_vs_adag"]}))
+
+
+if __name__ == "__main__":
+    main()

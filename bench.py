@@ -13,9 +13,12 @@ list carries all six measured configs:
 
 Each entry reports samples/s/chip, achieved TFLOPS (from XLA's compiled cost
 analysis of the actual round executable — fwd+bwd+optimizer+collectives) and %
-of the chip's bf16 peak (MFU). ``vs_baseline`` compares against the most recent
-prior-round record (``BENCH_r*.json``), per metric name; the reference itself
-publishes no throughput numbers (BASELINE.json ``published: {}``).
+of the chip's bf16 peak (MFU). ``vs_baseline`` compares against the committed
+protocol-matched pin (``BENCH_PIN.json``), with ``within_band`` flagging
+whether the delta is inside the allowed ±15 % tunnel-weather band and
+``vs_ceiling`` the fraction of the config's roofline-derived bound (metrics
+without a pin fall back to the most recent ``BENCH_r*.json``). The reference
+itself publishes no throughput numbers (BASELINE.json ``published: {}``).
 """
 
 from __future__ import annotations
@@ -68,6 +71,23 @@ _TRAIN_FLOPS_PER_SAMPLE = {
     "imdb_lstm_dynsgd": 3 * 39.3e6,
     "resnet50_sync": 3 * 4.1e9,
 }
+
+
+def _pin_config() -> tuple[dict, float]:
+    """(per-metric pin entries, weather band fraction) from BENCH_PIN.json.
+
+    The committed, protocol-matched baseline pin (VERDICT r4 weak #1):
+    ``vs_baseline`` is computed against these pins — NOT against the
+    previous round's artifact, which r4 showed machine-reads as a
+    regression across any protocol change — and ``within_band`` flags
+    whether the delta is inside the allowed tunnel-weather band."""
+    try:
+        with open(os.path.join(_REPO, "BENCH_PIN.json")) as f:
+            pin = json.load(f)
+        return (pin.get("configs", {}),
+                float(pin.get("weather_band_pct", 15)) / 100.0)
+    except (OSError, ValueError):
+        return {}, 0.15
 
 
 def _prior_values() -> dict[str, float]:
@@ -423,10 +443,15 @@ def scaling_sweep():
         # Headline = the north-star gate's analytic bound when computable
         # (the r3 verdict flagged the old measured-at-N=1 headline as a
         # tautology dressed as a measurement); the measured single/virtual-
-        # mesh points stay, honestly labeled.
+        # mesh points stay, honestly labeled. ``kind`` declares the
+        # headline's provenance so downstream tooling cannot mistake an
+        # analytic bound for a measurement (VERDICT r4 weak #4): on a
+        # one-chip host the sweep measures nothing beyond N=1, and the
+        # gate ratio lives under ``analytic_v5e``, not the top level.
         "metric": "cifar10_cnn_aeasgd_scaling_efficiency",
         "value": points[-1]["scaling_efficiency"],
         "unit": "ratio (throughput(N) / (N x throughput(1)))",
+        "kind": "measured",
         "vs_baseline": round(points[-1]["scaling_efficiency"] / 0.90, 3),
         "measured_points": points,
     }
@@ -446,8 +471,12 @@ def scaling_sweep():
         out["value"] = round(analytic.efficiency(64), 4)
         out["unit"] = ("ratio (analytic bound from measured single-chip "
                        "round; one ring direction, zero overlap)")
-        out["vs_baseline"] = round(analytic.efficiency(64) / 0.90, 3)
+        out["kind"] = "analytic-bound"
+        # The gate ratio is model-output / 0.90 — it belongs with the model,
+        # not in measurement clothing at the top level.
+        del out["vs_baseline"]
         out["analytic_v5e"] = {
+            "vs_gate_0p90": round(analytic.efficiency(64) / 0.90, 3),
             "basis": {
                 "measured_samples_per_s_per_chip": round(sps1, 1),
                 "round_seconds": round((window * batch) / sps1, 6),
@@ -603,6 +632,7 @@ def main():
         configs = [c for c in configs if any(tag in c[0] for tag in only)]
 
     prior = _prior_values()
+    pins, band = _pin_config()
     results = []
     for name, model_fn, discipline, kw in configs:
         t_cfg = time.perf_counter()
@@ -619,7 +649,16 @@ def main():
                 rec = {"metric": f"{name}_{kind}_per_sec_per_chip",
                        "value": None, "unit": f"{kind}/s/chip",
                        "error": f"{type(e).__name__}: {e}"}
-        if rec.get("value") and rec["metric"] in prior:
+        entry = pins.get(rec["metric"]) if rec.get("value") else None
+        if entry and entry.get("pin"):
+            rec["vs_baseline"] = round(rec["value"] / entry["pin"], 3)
+            rec["within_band"] = bool(
+                abs(rec["value"] / entry["pin"] - 1.0) <= band)
+            if entry.get("ceiling_samples_per_sec"):
+                rec["vs_ceiling"] = round(
+                    rec["value"] / entry["ceiling_samples_per_sec"], 3)
+        elif rec.get("value") and rec["metric"] in prior:
+            # Unpinned config (new this round): previous artifact, as before.
             rec["vs_baseline"] = round(rec["value"] / prior[rec["metric"]], 3)
         results.append(rec)
         print(f"[bench] {name}: {rec.get('value')} {rec.get('unit')} "
@@ -634,6 +673,7 @@ def main():
         "value": headline["value"],
         "unit": headline["unit"],
         "vs_baseline": headline.get("vs_baseline", 1.0),
+        "within_band": headline.get("within_band"),
         "achieved_tflops_per_chip": headline.get("achieved_tflops_per_chip"),
         "mfu_vs_bf16_peak": headline.get("mfu_vs_bf16_peak"),
         "configs": results,

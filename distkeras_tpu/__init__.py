@@ -25,7 +25,7 @@ the reference) on JAX/XLA:
   (:mod:`distkeras_tpu.trainers`).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 from distkeras_tpu.runtime.mesh import (  # noqa: F401
     DATA_AXIS,
