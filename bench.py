@@ -318,6 +318,64 @@ def _measure(name, model_fn, discipline, batch_size, window, sample_shape,
     }
 
 
+def _measure_async_transformer(name, *, num_layers, d_model, num_heads, d_ff,
+                               vocab, seq_len, batch, window=8, timed=4,
+                               reps=5):
+    """Config #7: the flagship flash transformer trained as ONE AEASGD
+    worker — the async-disciplines x flash composition's single-chip cost
+    (window-``window`` lax.scan of steps + the elastic fold per round,
+    remat'd blocks). The number to compare against config #6's bare SPMD
+    step; docs/PERFORMANCE.md 'Flash under the async disciplines'."""
+    import jax
+    import jax.numpy as jnp
+
+    from distkeras_tpu.data.batching import make_batches
+    from distkeras_tpu.data.dataframe import DataFrame
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.transformer import TransformerLM
+    from distkeras_tpu.parallel.disciplines import get_discipline
+    from distkeras_tpu.parallel.engine import AsyncEngine, stage_round
+    from distkeras_tpu.runtime.mesh import data_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:  # CPU smoke
+        num_layers, d_model, num_heads, d_ff = 2, 64, 2, 128
+        vocab, seq_len, batch, window, timed, reps = 256, 128, 2, 2, 2, 1
+
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        model = Model.build(
+            TransformerLM(vocab_size=vocab, num_layers=num_layers,
+                          d_model=d_model, num_heads=num_heads, d_ff=d_ff,
+                          max_seq_len=seq_len,
+                          attn_impl="flash" if on_tpu else "dense",
+                          remat=on_tpu),
+            jnp.zeros((1, 1), jnp.int32))
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, vocab, size=(batch * window * 2, seq_len))
+    df = DataFrame({"features": toks.astype(np.int32),
+                    "label": np.roll(toks, -1, 1).astype(np.int32)})
+    plan = make_batches(df, "features", "label", batch_size=batch,
+                        num_workers=1, window=window, num_epoch=1)
+    engine = AsyncEngine(
+        model, "adam", "sparse_categorical_crossentropy",
+        get_discipline("aeasgd", alpha=0.05), data_mesh(num_workers=1),
+        window=window, learning_rate=1e-4,
+        compute_dtype="bfloat16" if on_tpu else None)
+    xs, ys = stage_round(engine, plan, 0)
+    carry = {"s": engine.init_state()}
+
+    def one(_i):
+        carry["s"], loss = engine._round_fn(carry["s"], xs, ys)
+        return loss
+
+    times = _time_steps(one, 1, timed, reps=reps)
+    stats = _throughput_stats(times, timed * window * batch * seq_len)
+    return {"metric": f"{name}_tokens_per_sec_per_chip",
+            "value": round(stats["value"], 1), "unit": "tokens/s/chip",
+            "p50": stats["p50"], "p10": stats["p10"], "p90": stats["p90"],
+            "reps": stats["reps"]}
+
+
 def _measure_spmd_transformer(name, *, num_layers, d_model, num_heads, d_ff,
                               vocab, seq_len, batch, timed=12, warmup=2,
                               reps=None):
@@ -626,6 +684,13 @@ def main():
                     dict(num_layers=8, d_model=1024, num_heads=16, d_ff=4096,
                          vocab=32768, seq_len=2048, batch=8, timed=16)))
 
+    # 7 - the composition: the same flagship trained as an AEASGD worker
+    # (async discipline engine: window scan + elastic fold, remat). Expect
+    # ~80% of config #6's step rate (PERFORMANCE.md).
+    configs.append(("transformer_aeasgd_flash", None, "async_transformer",
+                    dict(num_layers=8, d_model=1024, num_heads=16, d_ff=4096,
+                         vocab=32768, seq_len=2048, batch=8)))
+
     # Optional subset for debugging: BENCH_CONFIGS=cifar10,resnet python bench.py
     only = [s for s in os.environ.get("BENCH_CONFIGS", "").split(",") if s]
     if only:
@@ -641,11 +706,14 @@ def main():
             try:
                 if discipline == "transformer":
                     rec = _measure_spmd_transformer(name, **kw)
+                elif discipline == "async_transformer":
+                    rec = _measure_async_transformer(name, **kw)
                 else:
                     rec = _measure(name, model_fn, discipline, **kw)
                 break
             except Exception as e:  # a config must never take down the whole bench
-                kind = "tokens" if discipline == "transformer" else "samples"
+                kind = ("tokens" if "transformer" in str(discipline)
+                        else "samples")
                 rec = {"metric": f"{name}_{kind}_per_sec_per_chip",
                        "value": None, "unit": f"{kind}/s/chip",
                        "error": f"{type(e).__name__}: {e}"}
