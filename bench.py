@@ -647,9 +647,12 @@ def main():
          dict(batch_size=2048 if on_tpu else 32, window=8,
               sample_shape=(28, 28, 1), num_classes=10, timed=rounds(32),
               rounds_per_program="auto")),
-        # 3 — NORTH STAR: CIFAR-10 CNN under AEASGD (elastic averaging)
+        # 3 — NORTH STAR: CIFAR-10 CNN under AEASGD (elastic averaging).
+        # B=2048: r5 same-process sweep 1024 -> 240.5k, 2048 -> 247.8k
+        # samples/s/chip (higher arithmetic intensity past the B=1024
+        # byte profile the r4 ceiling was derived at).
         ("cifar10_cnn_aeasgd", cifar10_cnn, "aeasgd",
-         dict(batch_size=1024 if on_tpu else 16, window=8,
+         dict(batch_size=2048 if on_tpu else 16, window=8,
               sample_shape=(32, 32, 3), num_classes=10, timed=rounds(16),
               rounds_per_program="auto")),
         # 4 — IMDB LSTM under DynSGD (staleness-aware)
@@ -720,8 +723,10 @@ def main():
         entry = pins.get(rec["metric"]) if rec.get("value") else None
         if entry and entry.get("pin"):
             rec["vs_baseline"] = round(rec["value"] / entry["pin"], 3)
+            cfg_band = (float(entry["band_pct"]) / 100.0
+                        if entry.get("band_pct") is not None else band)
             rec["within_band"] = bool(
-                abs(rec["value"] / entry["pin"] - 1.0) <= band)
+                abs(rec["value"] / entry["pin"] - 1.0) <= cfg_band)
             if entry.get("ceiling_samples_per_sec"):
                 rec["vs_ceiling"] = round(
                     rec["value"] / entry["ceiling_samples_per_sec"], 3)
