@@ -147,6 +147,18 @@ def main() -> None:
         from distkeras_tpu import ADAG
 
         trainer = ADAG(model, communication_window=4, **common)
+    elif os.environ.get("DK_TRAINER") == "adag_tp":
+        # AsyncTPEngine across processes (ADVICE r4 medium): each of W=2
+        # workers is a tp=2 submesh; with 2 devices per process the tp pair
+        # lives inside one process and the worker fold crosses DCN. The [W]
+        # loss history must be replicated (fully addressable) on every
+        # process — the exact crash the engine's out_spec P() prevents.
+        from distkeras_tpu import ADAG
+
+        kw = dict(common)
+        kw["num_workers"] = 2
+        trainer = ADAG(model, communication_window=4,
+                       parallel={"model": 2}, **kw)
     elif os.environ.get("DK_TRAINER") == "parallel":
         from distkeras_tpu import ParallelTrainer
 

@@ -96,6 +96,24 @@ def test_two_process_async_discipline(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_async_tensor_parallel(tmp_path):
+    """AsyncTPEngine on a multi-process mesh (ADVICE r4 medium): ADAG with
+    W=2 workers, each a tp=2 submesh, over 2 processes x 2 devices. The
+    per-worker [W] loss leaves the engine replicated, so both processes
+    collect the identical history (a data-sharded loss would crash
+    device_get on a non-fully-addressable array)."""
+    _job, rcs = _launch_job(tmp_path, {"DK_TRAINER": "adag_tp"}, timeout=600,
+                            job_name="pytest-2proc-adagtp")
+    assert rcs == [0, 0], f"worker processes failed: rcs={rcs}"
+    results = _read_results(tmp_path)
+    for r in results:
+        assert r["accuracy"] > 0.85, r
+    assert results[0]["history"] == pytest.approx(results[1]["history"],
+                                                  rel=1e-6)
+    assert results[0]["history"][-1] < results[0]["history"][0]
+
+
+@pytest.mark.slow
 def test_four_process_sync_and_async(tmp_path):
     """W>2 process topologies (VERDICT r2 missing #4): 4 processes x 2
     virtual devices = an 8-worker global mesh. Exercises put_global's
