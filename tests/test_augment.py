@@ -87,6 +87,46 @@ def test_device_transform_trains_from_uint8_store():
         assert h[-1] < h[0], h
 
 
+def test_checkpoint_resume_exact_under_device_transform(tmp_path):
+    """The augmentation rng rides the engine's carried key chain, so a
+    checkpointed run resumes to EXACTLY the uninterrupted run's weights —
+    per-round augmentations included."""
+    import distkeras_tpu as dk
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.cnn import SimpleCNN
+    from distkeras_tpu.ops.augment import flip_crop_transform
+
+    pytest.importorskip("orbax.checkpoint")
+    rng = np.random.default_rng(0)
+    n, hw, c = 256, 12, 3
+    y = rng.integers(0, c, size=n).astype(np.int32)
+    x = (rng.integers(0, 60, size=(n, hw, hw, 3))
+         + y[:, None, None, None] * 80).clip(0, 255).astype(np.uint8)
+    df = dk.DataFrame({"features": x, "label": y})
+
+    def model():
+        return Model.build(SimpleCNN(conv_features=(8,), dense=(16,),
+                                     num_outputs=c),
+                           jnp.zeros((1, hw, hw, 3), jnp.float32))
+
+    common = dict(loss="sparse_categorical_crossentropy", num_workers=2,
+                  batch_size=8, communication_window=2, learning_rate=0.05,
+                  device_transform=flip_crop_transform(pad=2))
+    full = dk.ADAG(model(), num_epoch=4, **common)
+    m_full = full.train(df)
+
+    ck = str(tmp_path / "ck")
+    a = dk.ADAG(model(), num_epoch=2, checkpoint_dir=ck, checkpoint_every=1,
+                **common)
+    a.train(df)
+    b = dk.ADAG(model(), num_epoch=4, checkpoint_dir=ck, checkpoint_every=1,
+                resume=True, **common)
+    m_b = b.train(df)
+    for p, q in zip(jax.tree.leaves(m_full.params),
+                    jax.tree.leaves(m_b.params)):
+        np.testing.assert_allclose(np.asarray(p), np.asarray(q), atol=1e-5)
+
+
 def test_uint8_predict_matches_float_predict():
     """Train/inference parity for raw-byte stores: Model.predict and
     ModelPredictor on uint8 features == the same features pre-divided by
