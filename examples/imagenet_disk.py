@@ -254,14 +254,21 @@ def main():
                groups=8),
         np.zeros((1, args.image_hw, args.image_hw, 3), np.float32), seed=0)
     workers = jax.device_count()
+    device_aug = args.augment == "device"
+    if device_aug:
+        from distkeras_tpu.ops.augment import flip_crop_transform
+
+        aug_kw = dict(device_transform=flip_crop_transform())
+    else:
+        aug_kw = dict(transform=augment)
     trainer = dk.SynchronousDistributedTrainer(
         model, loss="sparse_categorical_crossentropy", num_workers=workers,
         batch_size=args.batch_size, num_epoch=1, learning_rate=0.01,
-        steps_per_program=2, compute_dtype="bfloat16", transform=augment,
+        steps_per_program=2, compute_dtype="bfloat16", **aug_kw,
         on_round=lambda r, loss: print(f"round {r}: loss {float(loss):.4f}"))
     print(f"training ResNet sync-DP on {workers} worker(s) with random "
-          "crop/flip augmentation; one epoch streams the full logical "
-          "dataset from disk ...")
+          f"crop/flip augmentation ({args.augment}-side); one epoch streams "
+          "the full logical dataset from disk ...")
     trainer.train(sdf)
     h = trainer.get_history()
     print(f"done: {len(h)} rounds, loss {h[0]:.4f} -> {h[-1]:.4f}")
