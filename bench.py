@@ -102,8 +102,12 @@ def _prior_values() -> dict[str, float]:
                 rec = json.load(f)
         except (OSError, ValueError):
             continue
-        # Driver-written records wrap the bench JSON line under "parsed".
+        # Driver-written records wrap the bench JSON line under "parsed" —
+        # which is null when that round's bench crashed before printing its
+        # line; skip to the next-most-recent record instead of dying here.
         rec = rec.get("parsed", rec)
+        if not isinstance(rec, dict):
+            continue
         vals: dict[str, float] = {}
         if rec.get("metric") and rec.get("value"):
             vals[rec["metric"]] = float(rec["value"])
@@ -239,9 +243,46 @@ def _bench_engine(engine, plan, warmup: int, timed: int, rounds_per_program=1,
     return [t / (n_timed * R) * timed for t in times]
 
 
+def _measure_input_stall(engine, plan) -> float | None:
+    """Input-stall fraction of a short REAL-path run (RoundFeeder staging,
+    one dispatch per round): steady-state feeder wait seconds / wall.
+
+    The timed bench pre-stages batches on device, so it measures pure
+    compute; this companion number is what separates compute from data time
+    when comparing bench rounds (ISSUE 1 satellite). Round 0's wait is
+    excluded from numerator AND denominator — the feeder has nothing to
+    overlap yet, so its wait is the full stage time even when staging is
+    perfectly hidden in steady state (the docs/PERFORMANCE.md feed-overlap
+    convention: "near-zero past round 0 = staging fully hidden"). Callers
+    pass a several-round plan so the steady-state numerator has multiple
+    wait samples. The denominator is the dispatch-loop wall between the
+    first and last round callbacks — NOT the whole run(), whose trailing
+    D2H retire fence (~70-110 ms through a tunneled device) would swamp a
+    small config's ~30 ms of rounds and deflate the fraction several-fold."""
+    import time as _t
+
+    try:
+        ticks: list[float] = []
+
+        def cb(r, loss, st):
+            ticks.append(_t.perf_counter())
+
+        engine.run(plan, rounds_per_program=1, on_round=cb)
+        waits = getattr(engine, "feed_waits", [])
+        if len(ticks) < 2 or len(waits) < 2:
+            return None
+        loop_wall = ticks[-1] - ticks[0]
+        if loop_wall <= 0:
+            return None
+        return round(min(sum(waits[1:]) / loop_wall, 1.0), 4)
+    except Exception:
+        return None  # diagnostics must never fail the config
+
+
 def _measure(name, model_fn, discipline, batch_size, window, sample_shape,
              num_classes, timed=30, warmup=3, int_inputs=False, vocab=None,
-             optimizer="sgd", rounds_per_program=1, num_workers=None, reps=None):
+             optimizer="sgd", rounds_per_program=1, num_workers=None, reps=None,
+             measure_stall=True):
     """Build engine+plan for one config and measure it."""
     import jax
 
@@ -291,6 +332,17 @@ def _measure(name, model_fn, discipline, batch_size, window, sample_shape,
                              compute_dtype="bfloat16")
     times = _bench_engine(engine, plan, warmup, timed,
                           rounds_per_program=rounds_per_program, reps=reps)
+    stall_frac = None
+    if measure_stall:
+        # Longer real-path plan (same two rounds of data, more epochs): one
+        # warmup wait to discard + five steady-state samples, instead of the
+        # single noisy sample a 2-round plan would give. Runs AFTER the
+        # timed bench so the per-round program is already compiled (a
+        # compile inside the stall run would inflate the wall denominator).
+        stall_plan = make_batches(df, "features", "label", batch_size,
+                                  num_workers=workers, window=window,
+                                  num_epoch=3)
+        stall_frac = _measure_input_stall(engine, stall_plan)
     samples = timed * workers * window * batch_size
     # per chip IN USE (== all visible chips for the standard configs; the
     # scaling sweep pins smaller worker counts)
@@ -307,7 +359,7 @@ def _measure(name, model_fn, discipline, batch_size, window, sample_shape,
         peak = _chip_peak_flops(jax.devices()[0])
         if peak:
             mfu = achieved / peak
-    return {
+    rec = {
         "metric": f"{name}_samples_per_sec_per_chip",
         "value": round(sps_chip, 1),
         "unit": "samples/s/chip",
@@ -316,6 +368,9 @@ def _measure(name, model_fn, discipline, batch_size, window, sample_shape,
         "achieved_tflops_per_chip": round(tflops, 2) if tflops else None,
         "mfu_vs_bf16_peak": round(mfu, 4) if mfu else None,
     }
+    if measure_stall:
+        rec["input_stall_fraction"] = stall_frac
+    return rec
 
 
 def _measure_async_transformer(name, *, num_layers, d_model, num_heads, d_ff,
@@ -486,7 +541,8 @@ def scaling_sweep():
                        batch_size=batch, window=window,
                        sample_shape=(32, 32, 3), num_classes=10,
                        timed=8 if on_tpu else 2,
-                       rounds_per_program=2 if on_tpu else 1, num_workers=w)
+                       rounds_per_program=2 if on_tpu else 1, num_workers=w,
+                       measure_stall=False)
         per_chip = rec["value"]
         total = per_chip * w
         if base_per_chip is None:
@@ -699,6 +755,9 @@ def main():
     if only:
         configs = [c for c in configs if any(tag in c[0] for tag in only)]
 
+    from distkeras_tpu import telemetry
+
+    tele = telemetry.get()
     prior = _prior_values()
     pins, band = _pin_config()
     results = []
@@ -707,12 +766,13 @@ def main():
         rec = None
         for attempt in (1, 2):  # the device tunnel flakes occasionally; retry once
             try:
-                if discipline == "transformer":
-                    rec = _measure_spmd_transformer(name, **kw)
-                elif discipline == "async_transformer":
-                    rec = _measure_async_transformer(name, **kw)
-                else:
-                    rec = _measure(name, model_fn, discipline, **kw)
+                with tele.span(f"bench[{name}]"):
+                    if discipline == "transformer":
+                        rec = _measure_spmd_transformer(name, **kw)
+                    elif discipline == "async_transformer":
+                        rec = _measure_async_transformer(name, **kw)
+                    else:
+                        rec = _measure(name, model_fn, discipline, **kw)
                 break
             except Exception as e:  # a config must never take down the whole bench
                 kind = ("tokens" if "transformer" in str(discipline)
@@ -720,6 +780,10 @@ def main():
                 rec = {"metric": f"{name}_{kind}_per_sec_per_chip",
                        "value": None, "unit": f"{kind}/s/chip",
                        "error": f"{type(e).__name__}: {e}"}
+        tele.event("bench_config", {k: rec.get(k) for k in
+                                    ("metric", "value", "unit",
+                                     "input_stall_fraction", "error")
+                                    if rec.get(k) is not None})
         entry = pins.get(rec["metric"]) if rec.get("value") else None
         if entry and entry.get("pin"):
             rec["vs_baseline"] = round(rec["value"] / entry["pin"], 3)
@@ -749,8 +813,23 @@ def main():
         "within_band": headline.get("within_band"),
         "achieved_tflops_per_chip": headline.get("achieved_tflops_per_chip"),
         "mfu_vs_bf16_peak": headline.get("mfu_vs_bf16_peak"),
+        # Compute-vs-data split (real staged path, not the pre-staged timed
+        # loop): future bench rounds can tell an input-bound regression from
+        # a compute one.
+        "input_stall_fraction": headline.get("input_stall_fraction"),
         "configs": results,
     }
+    # Telemetry JSONL beside the bench record (driver captures stdout into
+    # BENCH_r*.json; the spans/counters/per-config events land here).
+    tele_path = os.environ.get("BENCH_TELEMETRY_PATH",
+                               os.path.join(_REPO, "BENCH_TELEMETRY.jsonl"))
+    try:
+        from distkeras_tpu.telemetry.exporters import write_jsonl
+
+        write_jsonl(tele, tele_path, extra={"source": "bench.py"})
+    except Exception as e:  # diagnostics never fail the bench
+        print(f"[bench] telemetry dump failed: {e}",
+              file=__import__("sys").stderr)
     print(json.dumps(out))
 
 

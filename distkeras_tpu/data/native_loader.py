@@ -90,21 +90,31 @@ def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
     Uses the native threaded gather when available, numpy fancy indexing
     otherwise (bit-identical results).
     """
+    from distkeras_tpu import telemetry
+
+    tele = telemetry.get()
     lib = get_lib()
     if lib is None or not src.flags.c_contiguous or src.dtype == object:
+        # Which path served the gather matters for perf triage: a silent
+        # fallback (toolchain missing, non-contiguous column) looks like a
+        # data-plane regression otherwise.
+        tele.counter("native.gather_fallback_calls").add(1)
         return src[idx]
     flat_idx = np.ascontiguousarray(idx.reshape(-1), np.int64)
     row_bytes = int(src.dtype.itemsize * np.prod(src.shape[1:], dtype=np.int64))
     if row_bytes == 0:
         return src[idx]
     out = np.empty((flat_idx.size,) + src.shape[1:], src.dtype)
-    rc = lib.dk_gather_rows(
-        src.ctypes.data_as(ctypes.c_void_p), src.shape[0], row_bytes,
-        flat_idx.ctypes.data_as(ctypes.c_void_p), flat_idx.size,
-        out.ctypes.data_as(ctypes.c_void_p), num_threads(),
-    )
+    with tele.span("native.gather"):
+        rc = lib.dk_gather_rows(
+            src.ctypes.data_as(ctypes.c_void_p), src.shape[0], row_bytes,
+            flat_idx.ctypes.data_as(ctypes.c_void_p), flat_idx.size,
+            out.ctypes.data_as(ctypes.c_void_p), num_threads(),
+        )
     if rc != 0:
         raise IndexError("gather index out of range")
+    tele.counter("native.gather_calls").add(1)
+    tele.counter("native.gather_bytes").add(float(out.nbytes))
     return out.reshape(idx.shape + src.shape[1:])
 
 

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterator
 
 
@@ -56,11 +57,21 @@ class RoundFeeder:
         return False
 
     def _run(self):
+        from distkeras_tpu import telemetry
+
+        tele = telemetry.get()
+        stage_span = tele.histogram("feeder.stage")
         try:
             for r in range(self.start_round, self.num_rounds):
                 if self._stop.is_set():
                     return
-                if not self._put((r, self.stage(r), None)):
+                t0 = time.perf_counter()
+                batch = self.stage(r)
+                # Producer-side cost (gather + transform + device_put), the
+                # counterpart of the consumer's ``input_stall``: staging
+                # slower than dispatch is what makes stalls appear.
+                stage_span.observe(time.perf_counter() - t0)
+                if not self._put((r, batch, None)):
                     return
         except BaseException as e:  # noqa: BLE001 - propagate to consumer
             self._put((-1, None, e))
@@ -96,8 +107,11 @@ class RoundFeeder:
             # too): fail loudly rather than silently yielding zero rounds.
             raise RuntimeError(
                 "RoundFeeder is closed; construct a new feeder per run")
-        import time
+        from distkeras_tpu import telemetry
 
+        tele = telemetry.get()
+        depth_gauge = tele.gauge("feeder.queue_depth")
+        fill_gauge = tele.gauge("feeder.fill_ratio")
         self._thread.start()
         try:
             wait = 0.0
@@ -118,6 +132,13 @@ class RoundFeeder:
                     raise err
                 if r is None:
                     return
+                # Lookahead health at each pop: depth 0 = the consumer is
+                # racing the feeder (stalls imminent); fill 1.0 = staging is
+                # fully hidden. qsize() is advisory but cheap and monotone
+                # enough for a gauge.
+                q = self._q.qsize()
+                depth_gauge.set(q)
+                fill_gauge.set(q / self.depth)
                 self.waits.append(wait)
                 wait = 0.0
                 yield r, batch

@@ -91,7 +91,7 @@ class CausalSelfAttention(nn.Module):
             # axis we manualize it locally — each shard runs flash on its own
             # heads (attention has no cross-head communication). Works inside
             # the SPMD engine's partially-manual region via nested shard_map.
-            am = jax.sharding.get_abstract_mesh()
+            am = getattr(jax.sharding, "get_abstract_mesh", lambda: None)()
             names = getattr(am, "axis_names", ())
             if MODEL_AXIS in names and am.shape[MODEL_AXIS] > 1 and (
                 _axis_is_auto(am, MODEL_AXIS)

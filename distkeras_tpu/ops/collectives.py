@@ -8,9 +8,57 @@ pytree-shaped wrappers.
 
 from __future__ import annotations
 
+import inspect
+
 import jax
 
 try:  # jax >= 0.7 exports shard_map at top level
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map  # type: ignore
+    _shard_map = jax.shard_map
+except AttributeError:  # older jax: experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
+
+_SM_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, **kwargs):
+    """jax.shard_map with the modern kwarg surface on every supported jax.
+
+    Callers use the >= 0.7 spelling — ``check_vma=`` (replication check) and
+    ``axis_names=`` (the MANUAL axes; unlisted mesh axes stay auto/GSPMD).
+    On older jax the same intent is expressed as ``check_rep=`` and its
+    complement ``auto=`` (the AUTO axes), so the shim translates rather than
+    dropping the kwargs — silently dropping ``axis_names`` would manualize
+    every axis and mis-shard any partially-auto engine.
+
+    Known limit: the translation restores the fully-manual engines
+    (Sync/Async/Pipeline) on jax 0.4.x, but 0.4.x's partial-auto shard_map
+    itself cannot compile this repo's partially-auto programs (rank-mismatch
+    sharding errors on rng keys) — AsyncTPEngine/SPMDEngine still require a
+    newer jax; their tests fail on 0.4.x exactly as before this shim.
+    """
+    if "check_vma" in kwargs and "check_vma" not in _SM_PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    if "axis_names" in kwargs and "axis_names" not in _SM_PARAMS:
+        manual = kwargs.pop("axis_names")
+        kwargs["auto"] = frozenset(mesh.axis_names) - frozenset(manual)
+    if f is None:  # decorator-style use
+        import functools
+
+        return functools.partial(shard_map, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **kwargs)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a bound mesh axis, on every supported jax.
+
+    ``lax.axis_size`` is recent; older jax exposes the same static value via
+    ``jax.core.axis_frame`` (which returns the size directly on 0.4.x). The
+    result must be a Python int — gpipe/ring schedules build Python-level
+    permutation lists from it.
+    """
+    size = getattr(jax.lax, "axis_size", None)
+    if size is not None:
+        return size(axis_name)
+    return int(jax.core.axis_frame(axis_name))  # type: ignore[attr-defined]

@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from distkeras_tpu.ops.collectives import axis_size
+
 _NEG = -1e30
 
 
@@ -35,7 +37,7 @@ def ring_attention(q, k, v, axis_name: str):
     """
     B, L, H, D = q.shape
     out_dtype = q.dtype
-    S = lax.axis_size(axis_name)
+    S = axis_size(axis_name)
     my = lax.axis_index(axis_name)
 
     qf = q.astype(jnp.float32)

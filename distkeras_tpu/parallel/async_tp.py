@@ -187,18 +187,29 @@ class AsyncTPEngine(AsyncEngine):
         return self._local_ranks_cache
 
     def _stage_local_round(self, plan, r):
+        from distkeras_tpu import telemetry
+
         # Worker w == data-axis rank w; its tp peers share the same rows.
-        lw = self._local_ranks
-        xs, ys = plan.round_local(r, lw)
-        put = lambda a: put_worker_local(
-            a, self.mesh, plan.num_workers, lw, 0, self._batch_spec())
-        return put(xs), put(ys)
+        # The tp-local stage span separates this engine's gather+assembly
+        # cost from the generic feeder stage time (run loops, on_round, and
+        # the dispatch/retire histograms are inherited from AsyncEngine's
+        # instrumented run_rounds — this path is the engine's only own code).
+        with telemetry.get().span("stage[tp-local]"):
+            lw = self._local_ranks
+            xs, ys = plan.round_local(r, lw)
+            put = lambda a: put_worker_local(
+                a, self.mesh, plan.num_workers, lw, 0, self._batch_spec())
+            return put(xs), put(ys)
 
     def _stage_local_block(self, plan, rs):
-        lw = self._local_ranks
-        batches = [plan.round_local(r, lw) for r in rs]
-        xs = np.stack([b[0] for b in batches])
-        ys = np.stack([b[1] for b in batches])
-        put = lambda a: put_worker_local(
-            a, self.mesh, plan.num_workers, lw, 1, P(None, *self._batch_spec()))
-        return put(xs), put(ys)
+        from distkeras_tpu import telemetry
+
+        with telemetry.get().span("stage[tp-local]"):
+            lw = self._local_ranks
+            batches = [plan.round_local(r, lw) for r in rs]
+            xs = np.stack([b[0] for b in batches])
+            ys = np.stack([b[1] for b in batches])
+            put = lambda a: put_worker_local(
+                a, self.mesh, plan.num_workers, lw, 1,
+                P(None, *self._batch_spec()))
+            return put(xs), put(ys)

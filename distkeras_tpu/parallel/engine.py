@@ -532,25 +532,50 @@ def run_rounds(engine, plan, state, start_round, on_round, rounds_per_program):
     ``"auto"`` — probe the per-round wall time and pick R to fill
     ``_AUTO_TARGET_S`` (~64 ms) of device work per dispatched program
     (semantics-preserving either way; see multi_round_fn)."""
-    if rounds_per_program == "auto":
-        return run_auto(engine, plan, state, start_round, on_round)
-    if int(rounds_per_program) > 1:
-        return run_blocked(engine, plan, state, start_round, on_round,
-                           int(rounds_per_program))
-    return run_per_round(engine, plan, state, start_round, on_round)
+    from distkeras_tpu import telemetry
+
+    # The run anchor span: every dispatch/retire/input_stall metric nests
+    # logically under this wall-clock total (the report's share column).
+    with telemetry.get().span("engine_run"):
+        if rounds_per_program == "auto":
+            return run_auto(engine, plan, state, start_round, on_round)
+        if int(rounds_per_program) > 1:
+            return run_blocked(engine, plan, state, start_round, on_round,
+                               int(rounds_per_program))
+        return run_per_round(engine, plan, state, start_round, on_round)
+
+
+def _record_feed_waits(engine, feeder) -> None:
+    """Persist the feeder's consumer-side wait times on the engine AND in
+    telemetry: ``input_stall`` is the time the run loop sat blocked on the
+    data plane — the compute-vs-data split every bench round needs."""
+    from distkeras_tpu import telemetry
+
+    engine.feed_waits = list(feeder.waits)
+    engine.feed_wait_seconds = float(sum(feeder.waits))
+    tele = telemetry.get()
+    stall = tele.histogram("input_stall")
+    for w in feeder.waits:
+        stall.observe(w)
+    tele.counter("input_stall_seconds").add(engine.feed_wait_seconds)
 
 
 def run_per_round(engine, plan, state, start_round, on_round):
     """One XLA dispatch per fold round, with background batch staging."""
+    from distkeras_tpu import telemetry
     from distkeras_tpu.data.prefetch import RoundFeeder
 
+    tele = telemetry.get()
     losses = []
     feeder = RoundFeeder(plan.num_rounds,
                          lambda r: stage_round(engine, plan, r),
                          start_round=start_round)
     try:
         for r, (xs, ys) in feeder:
-            new_state, loss = engine._round_fn(state, xs, ys)
+            # Dispatch span: host-side enqueue only (jax dispatch is async);
+            # the first round's entry absorbs compile time.
+            with tele.span("dispatch[per-round]"):
+                new_state, loss = engine._round_fn(state, xs, ys)
             # Keep the device value: fetching here would fence every dispatch
             # (~100 ms RTT through a tunneled device); convert once at the end.
             losses.append(loss)
@@ -565,11 +590,13 @@ def run_per_round(engine, plan, state, start_round, on_round):
         # Feed-overlap diagnostic (see RoundFeeder.waits): per-round consumer
         # block times; near-zero past round 0 = staging fully hidden behind
         # dispatch. docs/PERFORMANCE.md "Feed overlap" measures this in anger.
-        engine.feed_waits = list(feeder.waits)
-        engine.feed_wait_seconds = float(sum(feeder.waits))
+        _record_feed_waits(engine, feeder)
     # One batched fetch — per-item np.asarray would pay one D2H round-trip
-    # (~70-110 ms through a tunneled device) per round.
-    return state, np.asarray(jax.device_get(losses))
+    # (~70-110 ms through a tunneled device) per round. The retire span is
+    # this single fence: all dispatched-but-unfinished device work drains here.
+    with tele.span("retire[per-round]"):
+        host = jax.device_get(losses)
+    return state, np.asarray(host)
 
 
 #: auto-R sizing. The probe must measure the STEADY-STATE per-round cost:
@@ -630,15 +657,19 @@ def run_auto(engine, plan, state, start_round, on_round):
     state are identical to any fixed-R run."""
     import time as _time
 
+    from distkeras_tpu import telemetry
+
     if start_round >= plan.num_rounds:  # resumed past the end: nothing to do
         return state, np.asarray([])
+    tele = telemetry.get()
     losses = []
     r = start_round
     round_bytes = 1
 
     # Round 1 fences compile (its callback runs inline — we're not timing yet).
     xs, ys = stage_round(engine, plan, r)
-    state, loss = engine._round_fn(state, xs, ys)
+    with tele.span("dispatch[auto]"):
+        state, loss = engine._round_fn(state, xs, ys)
     losses.append(loss)
     if on_round is not None:
         on_round(r, loss, state)
@@ -660,7 +691,8 @@ def run_auto(engine, plan, state, start_round, on_round):
     while r < plan.num_rounds and n < _AUTO_PROBE_ROUNDS:
         xs, ys = stage_round(engine, plan, r)
         round_bytes = sum(int(a.nbytes) for a in jax.tree.leaves((xs, ys)))
-        state, loss = engine._round_fn(state, xs, ys)
+        with tele.span("dispatch[auto]"):  # ~µs span cost; rounds are ms
+            state, loss = engine._round_fn(state, xs, ys)
         losses.append(loss)
         pending.append((r, loss))
         r += 1
@@ -688,7 +720,7 @@ def run_auto(engine, plan, state, start_round, on_round):
     # num_rounds - r is process-deterministic, so the clamp preserves the
     # cross-process agreement _auto_size_r establishes.
     R = min(_auto_size_r(steady, round_bytes), plan.num_rounds - r)
-    state, rest = run_blocked(engine, plan, state, r, on_round, R)
+    state, rest = run_blocked(engine, plan, state, r, on_round, R, mode="auto")
     # Without callbacks the head losses were never needed earlier — fetch
     # them only now, after the blocked phase dispatched, so the device never
     # idled on a D2H fetch between probe and blocked work.
@@ -697,15 +729,20 @@ def run_auto(engine, plan, state, start_round, on_round):
     return state, np.concatenate([head, np.asarray(rest)], axis=0)
 
 
-def run_blocked(engine, plan, state, start_round, on_round, R):
+def run_blocked(engine, plan, state, start_round, on_round, R, mode="blocked"):
     """Engine run loop with ``R`` rounds per compiled program (one dispatch per
     block; see ``multi_round_fn``). Loss histories are identical to the
     per-round path; ``on_round`` still fires once per round but only the
     block-final call carries a state (interior calls get ``None`` — their
     states never materialize on the host). Shared by the async and sync
-    engines."""
+    engines. ``mode`` tags the telemetry histograms ("blocked", or "auto"
+    when run_auto sized R)."""
+    from distkeras_tpu import telemetry
     from distkeras_tpu.data.prefetch import RoundFeeder
 
+    tele = telemetry.get()
+    dispatch_span = f"dispatch[{mode}]"
+    retire_span = f"retire[{mode}]"
     starts = list(range(start_round, plan.num_rounds, R))
 
     def stage(i):
@@ -718,9 +755,14 @@ def run_blocked(engine, plan, state, start_round, on_round, R):
     try:
         for i, (xs, ys) in feeder:
             n = xs.shape[0]
-            new_state, block_losses = engine.multi_round_fn(n)(state, xs, ys)
+            with tele.span(dispatch_span):
+                new_state, block_losses = engine.multi_round_fn(n)(
+                    state, xs, ys)
             if on_round is not None:
-                host_losses = np.asarray(block_losses)
+                # The block fence: np.asarray blocks until the whole
+                # dispatched program retires — per-block retire latency.
+                with tele.span(retire_span):
+                    host_losses = np.asarray(block_losses)
                 for j in range(n):
                     # Only the block-final call carries state: interior
                     # rounds' states never exist on the host, and handing out
@@ -738,10 +780,11 @@ def run_blocked(engine, plan, state, start_round, on_round, R):
             state = new_state
     finally:
         feeder.close()  # deterministic even if the exception is retained
-        engine.feed_waits = list(feeder.waits)
-        engine.feed_wait_seconds = float(sum(feeder.waits))
+        _record_feed_waits(engine, feeder)
     if losses and on_round is None:  # device blocks: one batched fetch
-        losses = list(np.concatenate(jax.device_get(losses), axis=0))
+        with tele.span(retire_span):
+            fetched = jax.device_get(losses)
+        losses = list(np.concatenate(fetched, axis=0))
     return state, np.asarray(losses)
 
 

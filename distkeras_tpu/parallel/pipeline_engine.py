@@ -89,6 +89,7 @@ class PipelineEngine:
         learning_rate: float = 0.01,
         seed: int = 0,
         compute_dtype=None,
+        on_step=None,
     ):
         tl = model.module
         if not isinstance(tl, TransformerLM):
@@ -123,6 +124,14 @@ class PipelineEngine:
         self._opt_specs = mirror_tree_specs(
             jax.eval_shape(lambda p: self.tx.init(split(p)), model.params),
             (rep_a, stage_a), param_specs, P())
+        #: optional ``on_step(step_idx, loss)`` — the engine's own observation
+        #: point for direct ``step()`` use. The trainer path goes through
+        #: WindowedStepEngine -> run_rounds, which carries ``on_round`` and
+        #: the dispatch/retire telemetry; this hook covers callers driving
+        #: the engine raw (the loss passed is the DEVICE value — fetching it
+        #: fences the step, the caller's choice to pay).
+        self.on_step = on_step
+        self._step_count = 0
         self._step = self._build_step()
 
     # -- pure functions ----------------------------------------------------
@@ -225,7 +234,15 @@ class PipelineEngine:
         return NamedSharding(self.mesh, P(DATA_AXIS))
 
     def step(self, state: PipeState, tokens, targets):
-        return self._step(state, tokens, targets)
+        from distkeras_tpu import telemetry
+
+        # Host-side enqueue latency only (dispatch is async; no fence here).
+        with telemetry.get().span("pipeline.dispatch"):
+            state, loss = self._step(state, tokens, targets)
+        if self.on_step is not None:
+            self.on_step(self._step_count, loss)
+        self._step_count += 1
+        return state, loss
 
     def export_params(self, state: PipeState):
         rep, stage = jax.device_get(state.params)

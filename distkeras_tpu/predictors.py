@@ -28,6 +28,34 @@ class Predictor:
         raise NotImplementedError
 
 
+def _observe_shards(stream):
+    """Pass-through over a per-shard prediction stream that records per-shard
+    rows and wall time — the skew between shards (max/mean of either
+    histogram) is what the report surfaces for sharded inference.
+
+    Timed around the generator resume only: the consumer's work after each
+    yield (the per-shard ``np.save``) must not bleed into the NEXT shard's
+    observation, or a slow filesystem write on shard s would point skew
+    triage at shard s+1."""
+    import time as _time
+
+    from distkeras_tpu import telemetry
+
+    tele = telemetry.get()
+    rows = tele.histogram("predict.shard_rows")
+    secs = tele.histogram("predict.shard_seconds")
+    it = iter(stream)
+    while True:
+        t0 = _time.perf_counter()
+        try:
+            out = next(it)
+        except StopIteration:
+            return
+        secs.observe(_time.perf_counter() - t0)
+        rows.observe(float(len(out)))
+        yield out
+
+
 def _unlink_column_files(path: str, physical: str, num_shards: int) -> None:
     """Best-effort removal of a superseded physical column's shard files.
 
@@ -117,6 +145,9 @@ class ModelPredictor(Predictor):
     def _predict_array(self, x: np.ndarray) -> np.ndarray:
         """Model outputs for an arbitrary-length feature array, in fixed-shape
         padded chunks (every chunk hits the same compiled executable)."""
+        from distkeras_tpu import telemetry
+
+        tele = telemetry.get()
         n = len(x)
         outs = []
         for start in range(0, n, self.chunk_size):
@@ -124,9 +155,16 @@ class ModelPredictor(Predictor):
             pad = self.chunk_size - len(chunk)
             if pad:
                 chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad, axis=0)])
-            xb = put_global(np.asarray(chunk), self._shard)
-            out = np.asarray(self._fwd(self._params, self._state, xb))
+            # Per-chunk batch latency (stage + forward + fetch: np.asarray
+            # fences the program, so the span is the true end-to-end cost).
+            with tele.span("predict.chunk"):
+                xb = put_global(np.asarray(chunk), self._shard)
+                out = np.asarray(self._fwd(self._params, self._state, xb))
             outs.append(out[: len(out) - pad] if pad else out)
+        tele.counter("predict.rows").add(float(n))
+        if n:
+            tele.counter("predict.padded_rows").add(
+                float(-n % self.chunk_size))
         return self._postprocess(np.concatenate(outs, axis=0))
 
     def predict(self, dataframe) -> "DataFrame":
@@ -193,6 +231,9 @@ class ModelPredictor(Predictor):
                 sizes.popleft()
                 yield np.concatenate(parts, axis=0)
 
+        from distkeras_tpu import telemetry
+
+        pending_gauge = telemetry.get().gauge("predict.pending_rows")
         for microbatch in source:
             mb = np.asarray(microbatch)
             sizes.append(len(mb))
@@ -205,8 +246,12 @@ class ModelPredictor(Predictor):
                 pending.append(mb)
             if pending_rows() >= self.chunk_size:
                 compute(flush=False)
+            # Rows buffered awaiting a forward pass: a gauge pinned near
+            # chunk_size means the producer outruns the compute chunking.
+            pending_gauge.set(pending_rows())
             yield from drain()
         compute(flush=True)
+        pending_gauge.set(pending_rows())
         yield from drain()
 
     def _predict_sharded(self, sdf):
@@ -255,7 +300,8 @@ class ModelPredictor(Predictor):
         meta: dict = {}
         source = (chunk[self.features_col]
                   for chunk in sdf.iter_column_chunks(self.features_col))
-        for s, out in enumerate(self.predict_stream(source)):
+        for s, out in enumerate(
+                _observe_shards(self.predict_stream(source))):
             meta.update(dtype=str(out.dtype), shape=list(out.shape[1:]))
             np.save(os.path.join(store.path, _shard_file(s, physical)), out)
 
@@ -379,7 +425,8 @@ class ModelPredictor(Predictor):
                            chunk_size=self.chunk_size,
                            devices=jax.local_devices())
         source = (store.read_shard(s, self.features_col) for s in my_shards)
-        for s, out in zip(my_shards, local.predict_stream(source)):
+        for s, out in zip(my_shards,
+                          _observe_shards(local.predict_stream(source))):
             np.save(os.path.join(store.path, _shard_file(s, physical)), out)
 
         # Deterministic column spec, independent of owning any shards.

@@ -1,0 +1,7 @@
+"""CLI entry point: ``python -m distkeras_tpu.telemetry report run.jsonl``."""
+
+import sys
+
+from distkeras_tpu.telemetry.report import main
+
+sys.exit(main())

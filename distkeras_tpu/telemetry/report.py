@@ -1,0 +1,271 @@
+"""Run-report renderer: ``python -m distkeras_tpu.telemetry report run.jsonl``.
+
+Reads a JSONL produced by ``MetricsLogger`` (per-round records + the
+telemetry-summary record its ``close()`` appends) or by
+``telemetry.exporters.write_jsonl`` directly, and renders:
+
+* per-phase time breakdown (span totals, counts, means, share of the run);
+* throughput segments (the same burst-grouping ``MetricsLogger`` uses, so
+  blocked/auto runs report per-segment rates, not burst-tail garbage);
+* staleness summary (per-worker staleness distribution, DynSGD scales,
+  per-worker loss divergence) from discipline-aware round fields;
+* a straggler table: rounds whose wall time exceeds ``k`` x the median (and
+  any record-time ``straggler`` flags the live monitor set).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Optional
+
+import numpy as np
+
+from distkeras_tpu.telemetry.core import BURST_EPS_S
+from distkeras_tpu.telemetry.exporters import SUMMARY_KIND, read_jsonl
+from distkeras_tpu.telemetry.training import STRAGGLER_K, flag_stragglers
+
+
+def _round_records(records: list[dict]) -> list[dict]:
+    return [r for r in records if "round" in r and "kind" not in r]
+
+
+def _summaries(records: list[dict]) -> list[dict]:
+    return [r for r in records if r.get("kind") == SUMMARY_KIND]
+
+
+def _is_burst_tail(r: dict) -> bool:
+    """Interior round of a compiled block — not a timing boundary. The
+    explicit ``burst_tail`` marker (written by MetricsLogger from the
+    engine's state=None contract) is authoritative; the dt threshold is the
+    fallback for records that predate it."""
+    return bool(r.get("burst_tail",
+                      (r.get("round_seconds") or 0.0) < BURST_EPS_S))
+
+
+def throughput_segments(rounds: list[dict]) -> list[dict]:
+    """Burst-grouped throughput segments (rounds, seconds, samples/s)."""
+    segments: list[dict] = []
+    for r in rounds:
+        dt = r.get("round_seconds")
+        if dt is None:
+            continue
+        sps = r.get("samples_per_sec")
+        spr = sps * dt if sps else 0.0
+        if segments and _is_burst_tail(r):
+            segments[-1]["rounds"] += 1
+            segments[-1]["seconds"] += dt
+            segments[-1]["samples"] += spr
+        else:
+            segments.append(
+                {"rounds": 1, "seconds": dt, "samples": spr,
+                 "first_round": r["round"]})
+    for s in segments:
+        s["samples_per_sec"] = (s["samples"] / s["seconds"]
+                                if s["seconds"] > 0 else 0.0)
+    return segments
+
+
+def _hist_max(h: dict) -> float:
+    """Exact max when present; otherwise the upper bound of the highest
+    occupied bucket (windowed summaries from ``Telemetry.delta`` carry
+    count/total/mean/buckets only — a window has no well-defined min/max)."""
+    if "max" in h:
+        return h["max"]
+    from distkeras_tpu.telemetry.core import BUCKET_BOUNDS
+
+    buckets = h.get("buckets", [])
+    for i in range(len(buckets) - 1, -1, -1):
+        if buckets[i]:
+            return (BUCKET_BOUNDS[i] if i < len(BUCKET_BOUNDS)
+                    else float("inf"))
+    return 0.0
+
+
+def phase_breakdown(summary: dict) -> list[dict]:
+    """Span aggregates sorted by total time, with share of the longest
+    top-level span (the closest thing a JSONL has to 'the run')."""
+    spans = summary.get("spans", {})
+    rows = []
+    top_total = max(
+        (h.get("total", 0.0) for n, h in spans.items() if "/" not in n),
+        default=0.0)
+    for name, h in spans.items():
+        total = h.get("total", 0.0)
+        rows.append({
+            "span": name,
+            "count": h.get("count", 0),
+            "total_s": total,
+            "mean_s": h.get("mean", 0.0),
+            "max_s": _hist_max(h),
+            "share": (total / top_total) if top_total > 0 else None,
+        })
+    rows.sort(key=lambda r: -r["total_s"])
+    return rows
+
+
+def staleness_summary(rounds: list[dict]) -> Optional[dict]:
+    """Aggregate the discipline-aware per-round fields, if any."""
+    stale_rows = [r["staleness"] for r in rounds if "staleness" in r]
+    out: dict = {}
+    if stale_rows:
+        mat = np.asarray(stale_rows, dtype=np.float64)  # [rounds, W]
+        out["num_workers"] = mat.shape[1]
+        out["per_worker_mean"] = [round(float(v), 3) for v in mat.mean(0)]
+        out["per_worker_max"] = [int(v) for v in mat.max(0)]
+    scales = [r["dynsgd_scale"] for r in rounds if "dynsgd_scale" in r]
+    if scales:
+        mat = np.asarray(scales, dtype=np.float64)
+        out["dynsgd_scale_mean"] = [round(float(v), 4) for v in mat.mean(0)]
+    divs = [r["loss_divergence"] for r in rounds if "loss_divergence" in r]
+    if divs:
+        mat = np.asarray(divs, dtype=np.float64)
+        out["loss_divergence_rms"] = [
+            round(float(v), 6) for v in np.sqrt((mat ** 2).mean(0))]
+        out["loss_divergence_max_abs"] = round(float(np.abs(mat).max()), 6)
+    return out or None
+
+
+def straggler_table(rounds: list[dict], k: float = STRAGGLER_K) -> list[dict]:
+    """Rounds whose wall time exceeds ``k`` x the median round time (plus
+    any rounds the live monitor already flagged). Burst-tail rounds
+    (interior rounds of a compiled block) are real rounds but not timing
+    boundaries — they are excluded from both the median anchor and the
+    flagging, or every block-final round would flag against a tail-scale
+    median."""
+    timed = [(r["round"], r["round_seconds"], bool(r.get("straggler")))
+             for r in rounds
+             if r.get("round_seconds") and not _is_burst_tail(r)]
+    if not timed:
+        return []
+    times = [t for _, t, _ in timed]
+    med = float(np.median(times))
+    flagged = set(flag_stragglers(times, k))
+    return [
+        {"round": rd, "seconds": t,
+         "x_median": round(t / med, 2) if med > 0 else None,
+         "flagged_live": live}
+        for i, (rd, t, live) in enumerate(timed)
+        if i in flagged or live
+    ]
+
+
+def build_report(path: str, k: float = STRAGGLER_K) -> dict:
+    """The full structured report for one JSONL file."""
+    records = read_jsonl(path)
+    rounds = _round_records(records)
+    summaries = _summaries(records)
+    # Later summaries supersede earlier ones span-by-span (a re-used path
+    # accumulates one summary per run; the last run's registry is current).
+    merged: dict = {"spans": {}, "counters": {}, "gauges": {}}
+    for s in summaries:
+        for key in merged:
+            merged[key].update(s.get(key, {}))
+    segments = throughput_segments(rounds)
+    total_s = sum(s["seconds"] for s in segments)
+    return {
+        "path": path,
+        "rounds": len(rounds),
+        "total_round_seconds": total_s,
+        "phases": phase_breakdown(merged),
+        "counters": merged["counters"],
+        "gauges": merged["gauges"],
+        "segments": segments,
+        "staleness": staleness_summary(rounds),
+        "stragglers": straggler_table(rounds, k),
+        "losses": [r["loss"] for r in rounds if "loss" in r],
+    }
+
+
+def _fmt_seconds(s: float) -> str:
+    if s >= 1.0:
+        return f"{s:.2f}s"
+    if s >= 1e-3:
+        return f"{s * 1e3:.2f}ms"
+    return f"{s * 1e6:.0f}us"
+
+
+def render_report(report: dict) -> str:
+    """Human-readable text rendering of :func:`build_report` output."""
+    out = io.StringIO()
+    w = out.write
+    w(f"# Telemetry report — {report['path']}\n")
+    w(f"rounds: {report['rounds']}   "
+      f"round wall time: {_fmt_seconds(report['total_round_seconds'])}\n")
+    if report["losses"]:
+        w(f"loss: first {report['losses'][0]:.4f}  "
+          f"last {report['losses'][-1]:.4f}\n")
+
+    if report["phases"]:
+        w("\n## Phase breakdown (spans)\n")
+        w(f"{'span':<40} {'count':>7} {'total':>10} {'mean':>10} "
+          f"{'share':>6}\n")
+        for p in report["phases"]:
+            share = f"{p['share'] * 100:.0f}%" if p["share"] is not None else "-"
+            w(f"{p['span']:<40} {p['count']:>7} "
+              f"{_fmt_seconds(p['total_s']):>10} "
+              f"{_fmt_seconds(p['mean_s']):>10} {share:>6}\n")
+
+    if report["segments"]:
+        w("\n## Throughput segments\n")
+        w(f"{'first_round':>11} {'rounds':>7} {'seconds':>10} "
+          f"{'samples/s':>12}\n")
+        for s in report["segments"]:
+            sps = (f"{s['samples_per_sec']:,.0f}"
+                   if s["samples_per_sec"] else "-")
+            w(f"{s['first_round']:>11} {s['rounds']:>7} "
+              f"{s['seconds']:>10.4f} {sps:>12}\n")
+
+    stall = report["counters"].get("input_stall_seconds")
+    if stall is not None and report["total_round_seconds"] > 0:
+        frac = stall / report["total_round_seconds"]
+        w(f"\ninput stall: {_fmt_seconds(stall)} "
+          f"({frac * 100:.1f}% of round wall time)\n")
+
+    if report["staleness"]:
+        st = report["staleness"]
+        w("\n## Staleness\n")
+        if "per_worker_mean" in st:
+            w(f"workers: {st['num_workers']}\n")
+            w(f"per-worker mean staleness: {st['per_worker_mean']}\n")
+            w(f"per-worker max staleness:  {st['per_worker_max']}\n")
+        if "dynsgd_scale_mean" in st:
+            w(f"DynSGD mean fold scale:    {st['dynsgd_scale_mean']}\n")
+        if "loss_divergence_rms" in st:
+            w(f"loss divergence rms:       {st['loss_divergence_rms']}\n")
+            w(f"loss divergence max |.|:   "
+              f"{st['loss_divergence_max_abs']}\n")
+
+    w("\n## Stragglers\n")
+    if report["stragglers"]:
+        w(f"{'round':>7} {'seconds':>10} {'x median':>9} {'live flag':>10}\n")
+        for s in report["stragglers"]:
+            w(f"{s['round']:>7} {s['seconds']:>10.4f} "
+              f"{s['x_median']:>9} {str(s['flagged_live']):>10}\n")
+    else:
+        w("none flagged\n")
+    return out.getvalue()
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m distkeras_tpu.telemetry",
+        description="Render a run report from a metrics/telemetry JSONL.")
+    sub = parser.add_subparsers(dest="command", required=True)
+    rep = sub.add_parser("report", help="render a per-run report")
+    rep.add_argument("path", help="metrics/telemetry JSONL file")
+    rep.add_argument("--straggler-k", type=float, default=STRAGGLER_K,
+                     help="flag rounds slower than k x median "
+                          f"(default {STRAGGLER_K})")
+    rep.add_argument("--json", action="store_true",
+                     help="emit the structured report as JSON instead of text")
+    args = parser.parse_args(argv)
+    report = build_report(args.path, k=args.straggler_k)
+    if args.json:
+        import json
+
+        print(json.dumps(report, default=float))
+    else:
+        print(render_report(report), end="")
+    return 0

@@ -299,6 +299,7 @@ class Trainer:
             state = engine.init_state()
         if self.metrics_path:
             from distkeras_tpu.metrics import MetricsLogger
+            from distkeras_tpu.telemetry.training import DisciplineMonitor
 
             logger = MetricsLogger(
                 self.metrics_path,
@@ -307,6 +308,12 @@ class Trainer:
                 # they expose the true chip count for samples/s/chip.
                 num_chips=getattr(engine, "num_chips", plan.num_workers),
                 extra={"trainer": type(self).__name__},
+                # Discipline-aware round fields (staleness rotation, DynSGD
+                # scales, per-worker loss divergence, straggler flags) for
+                # engines that have a discipline; inert otherwise.
+                monitor=DisciplineMonitor(
+                    discipline=getattr(engine, "discipline", None),
+                    num_workers=getattr(engine, "num_workers", 1)),
             )
 
         save_due = [False]  # a scheduled save passed while no state was out
@@ -318,7 +325,10 @@ class Trainer:
 
         def on_round(r, loss, st):
             if logger is not None:
-                logger(r, loss)
+                # st=None marks interior rounds of a compiled block (the
+                # engine contract) — the logger's authoritative burst-tail
+                # signal for segmentation and straggler flagging.
+                logger(r, loss, st)
             if self.on_round is not None:
                 self.on_round(r, loss)
             if ckpt is None or not self.checkpoint_every:
@@ -338,6 +348,9 @@ class Trainer:
                 if ckpt.save(r + step_offset, st, wait=True, meta=_meta(r)):
                     save_due[0] = False
 
+        import contextlib
+
+        done = False
         try:
             state, losses = engine.run(
                 plan, state=state, start_round=start, on_round=on_round,
@@ -352,24 +365,27 @@ class Trainer:
                 step = max(final_r + step_offset,
                            (-1 if latest_now is None else latest_now) + 1)
                 ckpt.save(step, state, wait=True, meta=_meta(final_r))
-        except BaseException:
-            # Close on failure too: orbax's background threads and the
-            # metrics file handle must not leak across in-process retries.
-            # Suppress close errors (an in-flight async save can raise from
-            # wait_until_finished) so the root-cause failure propagates.
-            import contextlib
-
+            # Happy path closes UNsuppressed: a failed final checkpoint
+            # flush must surface, not vanish into a finally.
             if ckpt is not None:
-                with contextlib.suppress(Exception):
-                    ckpt.close()
+                ckpt.close()
             if logger is not None:
-                with contextlib.suppress(Exception):
-                    logger.close()
-            raise
-        if ckpt is not None:
-            ckpt.close()
-        if logger is not None:
-            logger.close()
+                logger.close()
+            done = True
+        finally:
+            # Failure path (including a close that itself raised): orbax's
+            # background threads and the metrics file handle must not leak
+            # across in-process retries. Close errors are suppressed (an
+            # in-flight async save can raise from wait_until_finished) so
+            # the root-cause exception propagates; MetricsLogger.close is
+            # idempotent, so the clean-exit double call is a no-op.
+            if not done:
+                if ckpt is not None:
+                    with contextlib.suppress(Exception):
+                        ckpt.close()
+                if logger is not None:
+                    with contextlib.suppress(Exception):
+                        logger.close()
         losses = np.asarray(losses)
         if losses.ndim == 2:  # async engines: [rounds, W] per-worker curves
             self.worker_histories = losses.T
