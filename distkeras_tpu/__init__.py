@@ -83,6 +83,11 @@ from distkeras_tpu.evaluators import (  # noqa: F401
     F1Evaluator,
     LossEvaluator,
 )
+from distkeras_tpu.resilience import (  # noqa: F401
+    FaultPlan,
+    Supervisor,
+    supervise,
+)
 
 __all__ = [
     "Trainer",
@@ -115,6 +120,9 @@ __all__ = [
     "AccuracyEvaluator",
     "F1Evaluator",
     "LossEvaluator",
+    "FaultPlan",
+    "Supervisor",
+    "supervise",
     "Model",
     "DATA_AXIS",
     "MODEL_AXIS",

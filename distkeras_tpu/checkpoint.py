@@ -73,8 +73,9 @@ class Checkpointer:
         save warns and skips the meta write so a stale sidecar is never left
         for a step that was not written.
         """
+        encoded = _encode(state)
         saved = bool(self._mngr.save(
-            step, args=ocp.args.StandardSave(_encode(state))))
+            step, args=ocp.args.StandardSave(encoded)))
         if not saved:
             import warnings
 
@@ -115,7 +116,9 @@ class Checkpointer:
             import time
 
             own_tmp = f".p{jax.process_index()}.tmp"
-            live = {f"{s_}.json" for s_ in self._mngr.all_steps()}
+            live_steps = self._mngr.all_steps()
+            live = {f"{s_}.json" for s_ in live_steps} | {
+                f"{s_}.digest.json" for s_ in live_steps}
             for name in os.listdir(meta_dir):
                 path = os.path.join(meta_dir, name)
                 if name.endswith(".json"):
@@ -134,6 +137,33 @@ class Checkpointer:
                         os.remove(path)
                     except OSError:
                         pass
+        if (jax.process_count() == 1
+                and os.environ.get("DKTPU_CKPT_DIGEST", "") != "0"):
+            # Integrity sidecar: a content hash of the exact tree handed to
+            # orbax. Restore re-hashes and compares (``verify=True``), so a
+            # bit-flipped payload that orbax would restore to silent garbage
+            # falls back to the previous step instead. Single-process only:
+            # hashing needs fully-addressable arrays.
+            from distkeras_tpu.resilience import integrity
+
+            meta_dir = os.path.join(self.directory, "meta")
+            os.makedirs(meta_dir, exist_ok=True)
+            integrity.write_digest(
+                os.path.join(meta_dir, f"{step}.digest.json"),
+                integrity.tree_digest(encoded))
+        from distkeras_tpu.resilience import faults as _faults
+
+        plan = _faults.active_plan()
+        if plan is not None and plan.ckpt_corrupt(step):
+            # ckpt_corrupt@step injection: scribble over the largest payload
+            # file once the async write has landed — the digest above was
+            # computed from the live state, so a verified restore MUST
+            # detect this.
+            self._mngr.wait_until_finished()
+            from distkeras_tpu.resilience import integrity
+
+            integrity.corrupt_step_dir(
+                os.path.join(self.directory, str(step)))
         if wait:
             self._mngr.wait_until_finished()
         return True
@@ -152,22 +182,64 @@ class Checkpointer:
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
-    def restore(self, target: Any, step: Optional[int] = None) -> Any:
+    def all_steps(self) -> list[int]:
+        """Every retained step, ascending."""
+        return sorted(self._mngr.all_steps())
+
+    def steps_desc(self) -> list[int]:
+        """Every retained step, newest first — the integrity-fallback
+        candidate order."""
+        return sorted(self._mngr.all_steps(), reverse=True)
+
+    def digest(self, step: int) -> Optional[dict]:
+        """The integrity sidecar saved with ``step`` (None if absent)."""
+        from distkeras_tpu.resilience import integrity
+
+        return integrity.read_digest(
+            os.path.join(self.directory, "meta", f"{step}.digest.json"))
+
+    def _verify(self, step: int, restored_encoded: Any) -> None:
+        """Raise CheckpointCorruptError when ``step``'s digest sidecar exists
+        and the restored tree does not hash to it (single-process only —
+        multi-host leaves have no fully-addressable bytes to hash)."""
+        if jax.process_count() > 1:
+            return
+        digest = self.digest(step)
+        if digest is None:
+            return
+        from distkeras_tpu.resilience import integrity
+        from distkeras_tpu.resilience.errors import CheckpointCorruptError
+
+        if not integrity.matches(restored_encoded, digest):
+            from distkeras_tpu import telemetry
+
+            telemetry.counter("resilience.ckpt_corrupt_detected").add(1)
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} in {self.directory} failed its "
+                "integrity check (content hash != digest sidecar)")
+
+    def restore(self, target: Any, step: Optional[int] = None,
+                verify: bool = False) -> Any:
         """Restore into the structure/shardings of ``target`` (a matching pytree,
         e.g. ``engine.init_state()``). Typed PRNG keys in ``target`` are re-wrapped
-        from their stored raw data, preserving the key impl."""
+        from their stored raw data, preserving the key impl. ``verify=True``
+        re-hashes the restored tree against the step's digest sidecar and
+        raises :class:`CheckpointCorruptError` on mismatch."""
         step = self._mngr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
         restored = self._mngr.restore(
             step, args=ocp.args.StandardRestore(_abstract(_encode(target)))
         )
+        if verify:
+            self._verify(step, restored)
         return jax.tree.map(
             lambda t, r: jax.random.wrap_key_data(r) if _is_key(t) else r,
             target, restored,
         )
 
-    def restore_host(self, target: Any, step: Optional[int] = None) -> Any:
+    def restore_host(self, target: Any, step: Optional[int] = None,
+                     verify: bool = False) -> Any:
         """Restore into ``target``'s *shapes* with the saved topology's
         shardings ignored — the raw material for elastic re-topology.
 
@@ -205,6 +277,8 @@ class Checkpointer:
                 "ignore", message="Sharding info not provided when restoring")
             restored = self._mngr.restore(
                 step, args=ocp.args.StandardRestore(abstract))
+        if verify:
+            self._verify(step, restored)
         return jax.tree.map(
             lambda t, r: jax.random.wrap_key_data(r) if _is_key(t) else r,
             target, restored,

@@ -8,10 +8,21 @@ async, so the main loop's only synchronous cost becomes a queue pop.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
-from typing import Callable, Iterator
+from typing import Callable, Iterator, Optional
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name, "").strip()
+    return float(v) if v else default
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name, "").strip()
+    return int(v) if v else default
 
 
 class RoundFeeder:
@@ -27,14 +38,44 @@ class RoundFeeder:
     dropped. Without this the daemon thread would sit blocked on
     ``Queue.put`` forever, pinning staged device arrays (HBM + host RAM) for
     the life of the process.
+
+    Resilience (docs/RESILIENCE.md):
+
+    * **Stage retry**: ``stage_retries`` (env ``DKTPU_FEEDER_RETRIES``,
+      default 0 = off) retries a *failed* stage call with exponential
+      backoff before propagating — transient gather errors (a flaky NFS
+      read) no longer kill the run.
+    * **Stall watchdog**: the consumer warns (``resilience.
+      feeder_stall_warnings``) at exponentially spaced thresholds starting
+      at ``stall_warn`` seconds (env ``DKTPU_FEEDER_WARN``, default 1.0)
+      while blocked on an empty queue, and after ``stall_timeout`` seconds
+      (env ``DKTPU_FEEDER_TIMEOUT``, default 300) declares the input
+      pipeline dead with :class:`~distkeras_tpu.resilience.errors.
+      FeederStalledError` — a wedged data plane fails the run (and hands
+      control to the Supervisor) instead of hanging it forever.
+    * **Injection**: ``stall@r:s`` / ``feeder_error@r`` faults from the
+      ambient :class:`~distkeras_tpu.resilience.faults.FaultPlan` fire in
+      :meth:`_stage_once` (item index = round in per-round mode, block
+      index under blocked execution).
     """
 
     def __init__(self, num_rounds: int, stage: Callable[[int], object],
-                 start_round: int = 0, depth: int = 2):
+                 start_round: int = 0, depth: int = 2,
+                 stall_timeout: Optional[float] = None,
+                 stall_warn: Optional[float] = None,
+                 stage_retries: Optional[int] = None,
+                 retry_backoff_s: float = 0.05):
         self.num_rounds = num_rounds
         self.stage = stage
         self.start_round = start_round
         self.depth = max(1, depth)
+        self.stall_timeout = (_env_float("DKTPU_FEEDER_TIMEOUT", 300.0)
+                              if stall_timeout is None else float(stall_timeout))
+        self.stall_warn = (_env_float("DKTPU_FEEDER_WARN", 1.0)
+                           if stall_warn is None else float(stall_warn))
+        self.stage_retries = (_env_int("DKTPU_FEEDER_RETRIES", 0)
+                              if stage_retries is None else int(stage_retries))
+        self.retry_backoff_s = float(retry_backoff_s)
         self._q: queue.Queue = queue.Queue(maxsize=self.depth)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -56,6 +97,36 @@ class RoundFeeder:
                 continue
         return False
 
+    def _stage_once(self, r: int):
+        """One stage attempt, with scheduled fault injection applied first."""
+        from distkeras_tpu.resilience import faults
+
+        plan = faults.active_plan()
+        if plan is not None:
+            stall = plan.feeder_stall(r)
+            if stall > 0:
+                time.sleep(stall)
+            if plan.feeder_error(r):
+                from distkeras_tpu.resilience.errors import InjectedFault
+
+                raise InjectedFault(
+                    f"feeder error injected at item {r} (DKTPU_FAULTS)")
+        return self.stage(r)
+
+    def _stage_with_retry(self, r: int, tele):
+        attempt = 0
+        while True:
+            try:
+                return self._stage_once(r)
+            except Exception:
+                # Only plain Exceptions retry: KeyboardInterrupt/SystemExit
+                # and close() must still win immediately.
+                if attempt >= self.stage_retries or self._stop.is_set():
+                    raise
+                tele.counter("resilience.feeder_retries").add(1)
+                time.sleep(self.retry_backoff_s * (2 ** attempt))
+                attempt += 1
+
     def _run(self):
         from distkeras_tpu import telemetry
 
@@ -66,7 +137,7 @@ class RoundFeeder:
                 if self._stop.is_set():
                     return
                 t0 = time.perf_counter()
-                batch = self.stage(r)
+                batch = self._stage_with_retry(r, tele)
                 # Producer-side cost (gather + transform + device_put), the
                 # counterpart of the consumer's ``input_stall``: staging
                 # slower than dispatch is what makes stalls appear.
@@ -112,9 +183,11 @@ class RoundFeeder:
         tele = telemetry.get()
         depth_gauge = tele.gauge("feeder.queue_depth")
         fill_gauge = tele.gauge("feeder.fill_ratio")
+        stall_counter = tele.counter("resilience.feeder_stall_warnings")
         self._thread.start()
         try:
             wait = 0.0
+            next_warn = self.stall_warn
             while True:
                 t0 = time.perf_counter()
                 try:
@@ -126,8 +199,35 @@ class RoundFeeder:
                     wait += time.perf_counter() - t0
                     if self._stop.is_set():
                         return
+                    # Stall watchdog: exponentially backed-off warnings
+                    # (1x, 2x, 4x... the warn threshold) while the data
+                    # plane produces nothing, then declare it dead. The
+                    # clock is per-round — it resets at every delivery.
+                    if wait >= next_warn and next_warn <= self.stall_timeout:
+                        stall_counter.add(1)
+                        tele.event("feeder_stall", {
+                            "waited_s": round(wait, 3),
+                            "timeout_s": self.stall_timeout})
+                        import warnings as _warnings
+
+                        _warnings.warn(
+                            f"input pipeline stalled: no batch for "
+                            f"{wait:.1f}s (timeout {self.stall_timeout:.0f}s)",
+                            stacklevel=2)
+                        next_warn *= 2
+                    if wait >= self.stall_timeout:
+                        from distkeras_tpu.resilience.errors import (
+                            FeederStalledError)
+
+                        tele.counter("resilience.feeder_stall_deaths").add(1)
+                        raise FeederStalledError(
+                            f"input pipeline produced nothing for "
+                            f"{wait:.1f}s (stall_timeout="
+                            f"{self.stall_timeout}s); declaring the data "
+                            "plane dead")
                     continue
                 wait += time.perf_counter() - t0
+                next_warn = self.stall_warn
                 if err is not None:
                     raise err
                 if r is None:

@@ -43,12 +43,22 @@ class Punchcard:
 
 
 class Job:
-    """Render + launch a multi-host training job (reference ``Job``)."""
+    """Render + launch a multi-host training job (reference ``Job``).
+
+    Beyond the reference's launch-and-pray: :meth:`supervise` restarts
+    failed hosts with exponential backoff and kills stragglers on a
+    timeout, :meth:`kill` escalates SIGTERM → SIGKILL, and :meth:`wait`
+    tears down stragglers when its timeout expires — the cluster-manager
+    duties the reference delegated to Spark task retry.
+    """
 
     def __init__(self, punchcard: Punchcard, ssh_user: Optional[str] = None):
         self.punchcard = punchcard
         self.ssh_user = ssh_user
         self._procs: list[subprocess.Popen] = []
+        self._cmds: list[str] = []
+        #: restarts performed per host by :meth:`supervise`.
+        self.restarts: list[int] = []
 
     def render_commands(self) -> list[str]:
         """One command line per host, with the jax.distributed bootstrap env."""
@@ -67,63 +77,139 @@ class Job:
             cmds.append(f"env {env_str} python {shlex.quote(pc.script)} {arg_str}".strip())
         return cmds
 
+    def _spawn(self, i: int) -> subprocess.Popen:
+        """(Re)launch host ``i``'s command."""
+        host, cmd = self.punchcard.hosts[i], self._cmds[i]
+        target = f"{self.ssh_user}@{host}" if self.ssh_user else host
+        if host in ("localhost", "127.0.0.1"):
+            # No shell wrapper: signals from kill()/terminate() must reach
+            # the actual python process, not an intermediate sh (whose
+            # death would orphan the trainer). The rendered command is
+            # shlex-quoted, so splitting reverses it exactly.
+            return subprocess.Popen(shlex.split(cmd))
+        # -tt forces a remote pty: killing the local ssh client then
+        # HUPs the remote job too, so kill() tears down the whole
+        # launch rather than orphaning trainers on the pod hosts.
+        return subprocess.Popen(["ssh", "-tt", target, cmd])
+
     def launch(self, dry_run: bool = True) -> list[str]:
         """Start the job on every host; with ``dry_run`` just return the commands."""
         cmds = self.render_commands()
         if dry_run:
             return cmds
-        for host, cmd in zip(self.punchcard.hosts, cmds):
-            target = f"{self.ssh_user}@{host}" if self.ssh_user else host
-            if host in ("localhost", "127.0.0.1"):
-                self._procs.append(subprocess.Popen(cmd, shell=True))
-            else:
-                # -tt forces a remote pty: killing the local ssh client then
-                # HUPs the remote job too, so kill() tears down the whole
-                # launch rather than orphaning trainers on the pod hosts.
-                self._procs.append(
-                    subprocess.Popen(["ssh", "-tt", target, cmd])
-                )
+        self._cmds = cmds
+        self.restarts = [0] * len(cmds)
+        for i in range(len(cmds)):
+            self._procs.append(self._spawn(i))
         return cmds
 
     def wait(self, timeout: Optional[float] = None) -> list[int]:
         """Block until every launched process exits; returns their exit codes.
 
-        ``timeout`` bounds the *total* wait (seconds); on expiry the pending
-        ``subprocess.TimeoutExpired`` propagates with the stragglers still
-        running (callers decide whether to kill).
+        ``timeout`` bounds the *total* wait (seconds); on expiry the
+        stragglers are torn down (SIGTERM → SIGKILL via :meth:`kill`) before
+        the pending ``subprocess.TimeoutExpired`` propagates — an expired
+        wait never leaves half a pod running behind the caller's back.
         """
         deadline = None if timeout is None else time.monotonic() + timeout
         rcs = []
-        for p in self._procs:
-            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-            rcs.append(p.wait(timeout=remaining))
+        try:
+            for p in self._procs:
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - time.monotonic()))
+                rcs.append(p.wait(timeout=remaining))
+        except subprocess.TimeoutExpired:
+            self.kill()
+            raise
         return rcs
 
     def poll(self) -> list:
         """Exit codes so far: one entry per host, ``None`` while running."""
         return [p.poll() for p in self._procs]
 
-    def supervise(self, timeout: float, grace: float = 5.0) -> list[int]:
-        """Babysit the job like a cluster manager: poll until every process
-        exits, or until the first nonzero exit (a failed host) — then give the
-        survivors ``grace`` seconds and tear the job down. Returns exit codes
-        (``-9`` for processes the teardown killed). This is the host-failure
-        detection the reference delegated to Spark's task retry."""
+    def supervise(self, timeout: float, grace: float = 5.0,
+                  max_restarts: int = 0, restart_backoff: float = 1.0,
+                  straggler_timeout: Optional[float] = None) -> list[int]:
+        """Babysit the job like a cluster manager. Polls until every process
+        exits. A host that exits nonzero is **restarted** (same command, up
+        to ``max_restarts`` times per host, after an exponential
+        ``restart_backoff * 2**n`` delay); once a host exhausts its restart
+        budget the survivors get ``grace`` seconds and the job is torn down
+        (the original first-failure semantics — the default
+        ``max_restarts=0`` behaves exactly as before). With
+        ``straggler_timeout`` set, hosts still running that long after the
+        first host finished cleanly are declared stragglers and killed.
+        Returns final exit codes (negative signal numbers for processes the
+        teardown killed). This is the host-failure detection AND recovery
+        the reference delegated to Spark's task retry."""
+        from distkeras_tpu import telemetry
+
         deadline = time.monotonic() + timeout
+        first_done_ok: Optional[float] = None
         while time.monotonic() < deadline:
             rcs = self.poll()
-            if all(rc is not None for rc in rcs):
-                return rcs
-            if any(rc not in (None, 0) for rc in rcs):
+            failed = [i for i, rc in enumerate(rcs) if rc not in (None, 0)]
+            if any(self.restarts[i] >= max_restarts for i in failed):
+                # Restart budget exhausted: first-failure teardown.
                 time.sleep(grace)
-                break
-            time.sleep(0.5)
+                self.kill()
+                return [p.returncode for p in self._procs]
+            if not failed and all(rc is not None for rc in rcs):
+                return rcs
+            for i in failed:
+                delay = restart_backoff * (2 ** self.restarts[i])
+                self.restarts[i] += 1
+                telemetry.counter("resilience.host_restarts").add(1)
+                telemetry.event("host_restart", {
+                    "host": self.punchcard.hosts[i], "index": i,
+                    "exit_code": rcs[i], "restart": self.restarts[i]})
+                time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+                self._procs[i] = self._spawn(i)
+            if straggler_timeout is not None:
+                if first_done_ok is None and 0 in rcs:
+                    first_done_ok = time.monotonic()
+                if (first_done_ok is not None
+                        and time.monotonic() - first_done_ok
+                        > straggler_timeout):
+                    stragglers = [i for i, rc in enumerate(self.poll())
+                                  if rc is None]
+                    telemetry.counter("resilience.straggler_kills").add(
+                        len(stragglers))
+                    telemetry.event("straggler_kill", {
+                        "hosts": [self.punchcard.hosts[i]
+                                  for i in stragglers]})
+                    self.kill()
+                    return [p.returncode for p in self._procs]
+            time.sleep(0.1)
         self.kill()
         return [p.returncode for p in self._procs]
 
-    def kill(self) -> None:
-        """Kill and reap every launched process that is still running."""
-        for p in self._procs:
+    def kill(self, grace: float = 5.0) -> None:
+        """Tear down every launched process that is still running:
+        SIGTERM first, then — for anything still alive after ``grace``
+        seconds — SIGKILL. The old single-SIGKILL-then-``wait()`` could
+        block forever on a process stuck unreapable; the escalation is
+        bounded at ~``2 * grace`` seconds worst-case, after which an
+        unreapable (D-state) process is abandoned rather than hanging the
+        caller."""
+        live = [p for p in self._procs if p.poll() is None]
+        for p in live:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        deadline = time.monotonic() + grace
+        for p in live:
+            try:
+                p.wait(timeout=max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+        for p in live:
             if p.poll() is None:
-                p.kill()
-                p.wait()
+                try:
+                    p.wait(timeout=grace)
+                except subprocess.TimeoutExpired:
+                    pass  # unreapable: do not hang the caller's teardown

@@ -36,6 +36,8 @@ from distkeras_tpu.ops.collectives import shard_map
 from distkeras_tpu.ops.losses import get_loss
 from distkeras_tpu.ops.optimizers import get_optimizer
 from distkeras_tpu.parallel.disciplines import Discipline
+from distkeras_tpu.resilience import faults as _faults
+from distkeras_tpu.resilience.guard import nan_guard_enabled
 from distkeras_tpu.runtime.mesh import DATA_AXIS, put_global
 from distkeras_tpu.workers import make_local_loop
 
@@ -84,6 +86,8 @@ class AsyncEngine:
         grad_accum: int = 1,
         workers_per_chip: int = 1,
         device_transform=None,
+        nan_guard: Optional[bool] = None,
+        divergence_reset: Optional[float] = None,
     ):
         self.model = model
         self.mesh = mesh
@@ -108,6 +112,16 @@ class AsyncEngine:
         self.num_chips = int(mesh.devices.size)
         self.seed = seed
         self.per_worker_init = per_worker_init
+        #: on-device NaN/Inf round skip (resilience layer): when any worker's
+        #: round loss goes non-finite, the round program keeps the previous
+        #: state — one isfinite reduce + a where-select per leaf, no host
+        #: round-trip. Default from DKTPU_NAN_GUARD (on unless "0").
+        self.nan_guard = (nan_guard_enabled() if nan_guard is None
+                          else bool(nan_guard))
+        #: opt-in divergent-worker reset threshold (resilience.RoundGuard):
+        #: |worker loss - mean| beyond it re-adopts the center. None = off.
+        self.divergence_reset = divergence_reset
+        self._reset_fn = None
         self.tx = get_optimizer(optimizer, learning_rate)
         self.loss_fn = get_loss(loss)
         self._local_loop = make_local_loop(
@@ -237,12 +251,34 @@ class AsyncEngine:
             return (new_center, new_local, new_opt, mstate,
                     disc.advance(fold_state), loss)
 
+        nan_guard = self.nan_guard
+
         def body(center, locals_, opt_state, fold_state, rng, model_state, xs, ys):
             # Inside shard_map: this slice carries m logical workers.
             step = _one_worker if m == 1 else _multiplexed
-            new_center, new_local, new_opt, model_state, new_fold_state, loss = step(
+            new_center, new_local, new_opt, new_model_state, new_fold_state, loss = step(
                 center, locals_, opt_state, fold_state, rng, model_state,
                 xs, ys)
+            if nan_guard:
+                # Resilience NaN/Inf skip: ONE worker's non-finite commit
+                # contaminates the psum'd center for every replica, so the
+                # whole round is discarded when any worker's loss went
+                # non-finite — old state (params, opt, stats, fold counter)
+                # carries forward; the reported loss keeps the NaN so host
+                # accounting (resilience.nonfinite_rounds) still sees it.
+                # ``loss`` is the replicated [W] all-gather, so every shard
+                # takes the same branch. Cost when healthy: an isfinite
+                # reduce + one cond select (measured cheaper than per-leaf
+                # where) — below run-to-run noise next to the K-step loop.
+                ok = jnp.all(jnp.isfinite(loss))
+                (new_center, new_local, new_opt, new_model_state,
+                 new_fold_state) = lax.cond(
+                    ok,
+                    lambda: (new_center, new_local, new_opt,
+                             new_model_state, new_fold_state),
+                    lambda: (center, locals_, opt_state, model_state,
+                             fold_state))
+            model_state = new_model_state
             # Per-worker window-mean losses, all-gathered so the [W] history
             # vector is REPLICATED (fully addressable on every process of a
             # multi-host mesh — a data-sharded loss can't be fetched on the
@@ -398,6 +434,39 @@ class AsyncEngine:
                 jax.tree.map(jnp.asarray, model_state), W), wshard),
         )
 
+    def reset_workers(self, state: EngineState, worker_mask) -> EngineState:
+        """Re-join the masked workers from the center (resilience layer: the
+        divergent-worker reset). Reference semantics are the rejoining-worker
+        PS pull: masked replicas take the center's params and a fresh
+        optimizer; unmasked workers, the center, fold state, and rng are
+        untouched. ``worker_mask`` is a host ``[W]`` bool array; the select
+        runs as one jitted program (no donation — the caller's state stays
+        valid until the new one is returned)."""
+        mask = np.asarray(worker_mask, dtype=bool)
+        if mask.shape != (self.num_workers,):
+            raise ValueError(
+                f"worker_mask must be [{self.num_workers}], got {mask.shape}")
+        if self._reset_fn is None:
+            W = self.num_workers
+
+            def _select(fresh, old, m):
+                def sel(f, o):
+                    mm = m.reshape((W,) + (1,) * (f.ndim - 1))
+                    return jnp.where(mm, f, o)
+
+                return jax.tree.map(sel, fresh, old)
+
+            def reset(st: EngineState, m):
+                fresh_locals = _stack_for_workers(st.center, W)
+                fresh_opt = _stack_for_workers(self.tx.init(st.center), W)
+                return st._replace(
+                    locals_=_select(fresh_locals, st.locals_, m),
+                    opt_state=_select(fresh_opt, st.opt_state, m),
+                )
+
+            self._reset_fn = jax.jit(reset)
+        return self._pin_state(self._reset_fn(state, mask))
+
     def _put_batch(self, xs: np.ndarray, ys: np.ndarray):
         shard = NamedSharding(self.mesh, self._batch_spec())
         return put_global(xs, shard), put_global(ys, shard)
@@ -472,6 +541,53 @@ def put_worker_local(local, mesh, num_workers: int, local_workers: list[int],
     return jax.make_array_from_callback(tuple(global_shape), sharding, cb)
 
 
+def _poison_rows(x, kind: str, idx: int):
+    """Poison worker slice ``idx`` (leading axis) of a staged device array:
+    multiply by NaN/Inf so the values — and everything backprop touches —
+    go non-finite, without re-staging. Non-float batches (token ids) cannot
+    carry a NaN; that misfire warns instead of silently consuming the
+    one-shot fault."""
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        import warnings
+
+        warnings.warn(
+            f"{kind}@ batch fault scheduled on a non-float batch "
+            f"(dtype {x.dtype}): cannot poison token ids — the fault is "
+            "consumed with no effect", stacklevel=2)
+        return x
+    bad = x.dtype.type(float("nan") if kind == "nan" else float("inf"))
+    return x.at[idx].mul(bad)
+
+
+def _maybe_poison_round(r: int, xs):
+    """Apply any scheduled nan/inf batch fault for round ``r`` (one-shot)."""
+    fp = _faults.active_plan()
+    if fp is None:
+        return xs
+    kind = fp.batch_fault(r)
+    if kind is None:
+        return xs
+    return _poison_rows(xs, kind, fp.poison_worker(r, int(xs.shape[0])))
+
+
+def _maybe_poison_block(rs, xs):
+    """Block twin of :func:`_maybe_poison_round` over ``[R, W, ...]``."""
+    fp = _faults.active_plan()
+    if fp is None:
+        return xs
+    for j, r in enumerate(rs):
+        kind = fp.batch_fault(r)
+        if kind is None:
+            continue
+        if not jnp.issubdtype(xs.dtype, jnp.floating):
+            _poison_rows(xs, kind, 0)  # shares the misfire warning
+            continue
+        w = fp.poison_worker(r, int(xs.shape[1]))
+        bad = xs.dtype.type(float("nan") if kind == "nan" else float("inf"))
+        xs = xs.at[j, w].mul(bad)
+    return xs
+
+
 def stage_round(engine, plan, r: int):
     """Gather + device-stage one round's batch, honouring plan locality.
 
@@ -479,7 +595,14 @@ def stage_round(engine, plan, r: int):
     (``is_local``) on a multi-process mesh gather only this process's
     workers' rows from disk and assemble the global array from them.
     Single-process, the full ``round`` gather IS the local gather (every
-    shard is addressable), so the plain path serves both."""
+    shard is addressable), so the plain path serves both. Any scheduled
+    ``nan@r``/``inf@r`` fault poisons the staged features here — the single
+    choke point every engine's staging passes through."""
+    xs, ys = _stage_round_raw(engine, plan, r)
+    return _maybe_poison_round(r, xs), ys
+
+
+def _stage_round_raw(engine, plan, r: int):
     if getattr(plan, "is_local", False) and jax.process_count() > 1:
         hook = getattr(engine, "_stage_local_round", None)
         if hook is not None:  # step engines: locality by dp rank, own specs
@@ -495,6 +618,11 @@ def stage_round(engine, plan, r: int):
 
 def stage_block(engine, plan, rs) -> tuple:
     """Stage a ``[R, W, K, B, ...]`` block of rounds (worker axis at dim 1)."""
+    xs, ys = _stage_block_raw(engine, plan, rs)
+    return _maybe_poison_block(rs, xs), ys
+
+
+def _stage_block_raw(engine, plan, rs) -> tuple:
     # Engines with a batch-spec hook (seq-sharded AsyncTP) stage the block in
     # the round body's layout — otherwise XLA reshards the full block inside
     # every dispatched program.
@@ -533,16 +661,25 @@ def run_rounds(engine, plan, state, start_round, on_round, rounds_per_program):
     ``_AUTO_TARGET_S`` (~64 ms) of device work per dispatched program
     (semantics-preserving either way; see multi_round_fn)."""
     from distkeras_tpu import telemetry
+    from distkeras_tpu.resilience.guard import note_losses
 
     # The run anchor span: every dispatch/retire/input_stall metric nests
     # logically under this wall-clock total (the report's share column).
     with telemetry.get().span("engine_run"):
         if rounds_per_program == "auto":
-            return run_auto(engine, plan, state, start_round, on_round)
-        if int(rounds_per_program) > 1:
-            return run_blocked(engine, plan, state, start_round, on_round,
-                               int(rounds_per_program))
-        return run_per_round(engine, plan, state, start_round, on_round)
+            state, losses = run_auto(engine, plan, state, start_round,
+                                     on_round)
+        elif int(rounds_per_program) > 1:
+            state, losses = run_blocked(engine, plan, state, start_round,
+                                        on_round, int(rounds_per_program))
+        else:
+            state, losses = run_per_round(engine, plan, state, start_round,
+                                          on_round)
+    # Post-hoc resilience accounting on the already-fetched history — the
+    # rounds the on-device NaN guard skipped show up here as non-finite
+    # loss rows (resilience.nonfinite_rounds), with no extra fences.
+    note_losses(losses)
+    return state, losses
 
 
 def _record_feed_waits(engine, feeder) -> None:
@@ -564,14 +701,17 @@ def run_per_round(engine, plan, state, start_round, on_round):
     """One XLA dispatch per fold round, with background batch staging."""
     from distkeras_tpu import telemetry
     from distkeras_tpu.data.prefetch import RoundFeeder
+    from distkeras_tpu.resilience.guard import RoundGuard
 
     tele = telemetry.get()
+    guard = RoundGuard(engine)
     losses = []
     feeder = RoundFeeder(plan.num_rounds,
                          lambda r: stage_round(engine, plan, r),
                          start_round=start_round)
     try:
         for r, (xs, ys) in feeder:
+            guard.pre_round(r)  # crash/kill fault injection, if scheduled
             # Dispatch span: host-side enqueue only (jax dispatch is async);
             # the first round's entry absorbs compile time.
             with tele.span("dispatch[per-round]"):
@@ -581,7 +721,19 @@ def run_per_round(engine, plan, state, start_round, on_round):
             losses.append(loss)
             if on_round is not None:
                 on_round(r, loss, new_state)
-            state = new_state
+            # Divergent-worker reset (no-op — and no fence — unless enabled).
+            state = guard.post_round(r, loss, new_state)
+    except BaseException:
+        # A crash mid-run still accounts the rounds already executed (the
+        # supervised-recovery path reads resilience.nonfinite_rounds for
+        # faults that landed BEFORE the crash).
+        import contextlib
+
+        with contextlib.suppress(Exception):
+            from distkeras_tpu.resilience.guard import note_losses
+
+            note_losses(np.asarray(jax.device_get(losses)))
+        raise
     finally:
         # Deterministic shutdown even when the escaping exception (and its
         # traceback's frames) is retained by the caller — generator GC alone
@@ -658,21 +810,25 @@ def run_auto(engine, plan, state, start_round, on_round):
     import time as _time
 
     from distkeras_tpu import telemetry
+    from distkeras_tpu.resilience.guard import RoundGuard
 
     if start_round >= plan.num_rounds:  # resumed past the end: nothing to do
         return state, np.asarray([])
     tele = telemetry.get()
+    guard = RoundGuard(engine)
     losses = []
     r = start_round
     round_bytes = 1
 
     # Round 1 fences compile (its callback runs inline — we're not timing yet).
     xs, ys = stage_round(engine, plan, r)
+    guard.pre_round(r)
     with tele.span("dispatch[auto]"):
         state, loss = engine._round_fn(state, xs, ys)
     losses.append(loss)
     if on_round is not None:
         on_round(r, loss, state)
+    state = guard.post_round(r, loss, state)
     r += 1
     jax.block_until_ready(loss)
 
@@ -691,8 +847,13 @@ def run_auto(engine, plan, state, start_round, on_round):
     while r < plan.num_rounds and n < _AUTO_PROBE_ROUNDS:
         xs, ys = stage_round(engine, plan, r)
         round_bytes = sum(int(a.nbytes) for a in jax.tree.leaves((xs, ys)))
+        guard.pre_round(r)
         with tele.span("dispatch[auto]"):  # ~µs span cost; rounds are ms
             state, loss = engine._round_fn(state, xs, ys)
+        # NOTE: an enabled divergence reset fences each probe round (it must
+        # read the loss) — the probe then measures the fenced per-round cost
+        # and sizes R conservatively. Correctness is unaffected.
+        state = guard.post_round(r, loss, state)
         losses.append(loss)
         pending.append((r, loss))
         r += 1
@@ -739,8 +900,10 @@ def run_blocked(engine, plan, state, start_round, on_round, R, mode="blocked"):
     when run_auto sized R)."""
     from distkeras_tpu import telemetry
     from distkeras_tpu.data.prefetch import RoundFeeder
+    from distkeras_tpu.resilience.guard import RoundGuard
 
     tele = telemetry.get()
+    guard = RoundGuard(engine)
     dispatch_span = f"dispatch[{mode}]"
     retire_span = f"retire[{mode}]"
     starts = list(range(start_round, plan.num_rounds, R))
@@ -755,6 +918,10 @@ def run_blocked(engine, plan, state, start_round, on_round, R, mode="blocked"):
     try:
         for i, (xs, ys) in feeder:
             n = xs.shape[0]
+            # Crash/kill faults land at the block boundary containing their
+            # round — interior rounds of a compiled program are indivisible.
+            for rr in range(starts[i], starts[i] + n):
+                guard.pre_round(rr)
             with tele.span(dispatch_span):
                 new_state, block_losses = engine.multi_round_fn(n)(
                     state, xs, ys)
@@ -772,12 +939,27 @@ def run_blocked(engine, plan, state, start_round, on_round, R, mode="blocked"):
                     st = new_state if j == n - 1 else None
                     on_round(starts[i] + j, host_losses[j], st)
                 losses.extend(host_losses)
+                state = guard.post_round(starts[i] + n - 1, block_losses[-1],
+                                         new_state,
+                                         host_loss=host_losses[-1])
             else:
                 # No callbacks -> keep losses on device; one per-block D2H
                 # fence would idle the device for the ~70-110 ms tunnel RTT
                 # every block. One batched fetch at the end instead.
                 losses.append(block_losses)
-            state = new_state
+                state = guard.post_round(starts[i] + n - 1, block_losses[-1],
+                                         new_state)
+    except BaseException:
+        import contextlib
+
+        with contextlib.suppress(Exception):  # see run_per_round's twin
+            from distkeras_tpu.resilience.guard import note_losses
+
+            fetched = jax.device_get(losses)
+            if fetched:
+                note_losses(np.vstack(
+                    [np.atleast_1d(np.asarray(f)) for f in fetched]))
+        raise
     finally:
         feeder.close()  # deterministic even if the exception is retained
         _record_feed_waits(engine, feeder)

@@ -51,7 +51,16 @@ class SyncEngine:
         grad_accum: int = 1,
         workers_per_chip: int = 1,
         device_transform=None,
+        nan_guard: "bool | None" = None,
     ):
+        from distkeras_tpu.resilience.guard import nan_guard_enabled
+
+        #: on-device NaN/Inf round skip (see AsyncEngine.nan_guard): a
+        #: non-finite window keeps the previous (params, opt, stats) —
+        #: replicas stay in lockstep because the skip decision is made on
+        #: the pmean'd (replicated) losses.
+        self.nan_guard = (nan_guard_enabled() if nan_guard is None
+                          else bool(nan_guard))
         self.model = model
         self.mesh = mesh
         #: m logical workers per chip (reference parity: num_workers is a
@@ -100,6 +109,7 @@ class SyncEngine:
         )
 
         m = self.workers_per_chip
+        nan_guard = self.nan_guard
 
         def body(params, opt_state, rng, model_state, xs, ys):
             # xs: [m, K, B, ...] on this slice — same worker-major layout as
@@ -118,14 +128,24 @@ class SyncEngine:
             # Per-replica dropout stream; the *carried* rng stays replicated (the
             # divergent key never leaves the local loop).
             step_rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
-            params, opt_state, model_state, losses = local_loop(
+            new_params, new_opt, new_model_state, losses = local_loop(
                 params, opt_state, xs0, ys0, step_rng, model_state)
             # Running statistics re-sync: each replica saw its own batch slice;
             # the mean is the canonical cross-replica estimate (params need no
             # such sync — the per-step gradient pmean keeps them identical).
-            model_state = lax.pmean(model_state, DATA_AXIS)
+            new_model_state = lax.pmean(new_model_state, DATA_AXIS)
+            if nan_guard:
+                # Resilience NaN/Inf skip: a non-finite window would leave
+                # every replica's params poisoned through the gradient pmean
+                # — discard the round instead. ``losses`` are the pmean'd
+                # (replicated) per-step losses, so all replicas agree.
+                ok = jnp.all(jnp.isfinite(losses))
+                new_params, new_opt, new_model_state = lax.cond(
+                    ok,
+                    lambda: (new_params, new_opt, new_model_state),
+                    lambda: (params, opt_state, model_state))
             next_rng = jax.random.split(rng, 1)[0]
-            return params, opt_state, next_rng, model_state, losses
+            return new_params, new_opt, next_rng, new_model_state, losses
 
         mapped = shard_map(
             body,

@@ -1,0 +1,29 @@
+"""Resilience exception taxonomy.
+
+Every failure the subsystem *detects* (as opposed to merely propagates) is
+raised as one of these, so the :class:`~distkeras_tpu.resilience.supervisor.
+Supervisor` and tests can match on type instead of message strings.
+"""
+
+from __future__ import annotations
+
+
+class ResilienceError(RuntimeError):
+    """Base class for every resilience-layer failure."""
+
+
+class InjectedFault(ResilienceError):
+    """A fault deliberately injected by a :class:`FaultPlan` — raised so the
+    recovery path under test sees a real exception, and so accidental
+    production use of ``DKTPU_FAULTS`` is unmistakable in a traceback."""
+
+
+class FeederStalledError(ResilienceError):
+    """The input pipeline produced nothing for longer than the watchdog
+    timeout — the run loop declares the data plane dead rather than hanging
+    forever on an empty queue."""
+
+
+class CheckpointCorruptError(ResilienceError):
+    """A restored checkpoint failed its integrity check (hash sidecar
+    mismatch). Callers fall back to the previous step."""

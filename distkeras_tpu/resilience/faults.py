@@ -1,0 +1,225 @@
+"""Deterministic fault injection: the :class:`FaultPlan`.
+
+The reference delegated all fault handling to Spark task retry and never
+tested it (``job_deployment.py`` docstring); here every recovery path is
+driven by *injected* faults so it is exercised, not asserted. A plan is a
+set of ``kind@at[:arg]`` entries, parsed from the ``DKTPU_FAULTS`` env var
+(or built programmatically), and each fault fires **exactly once** per
+process — a resumed run re-executing the poisoned round must not be
+re-poisoned, or no recovery loop could ever converge.
+
+Syntax (``;``-separated entries)::
+
+    DKTPU_FAULTS="nan@3;stall@5:0.5;crash@7;seed=11"
+
+=================  ==========================================================
+``nan@R``          poison round R's staged batch to NaN — the loss AND the
+                   gradients of that round go non-finite through backprop
+``inf@R``          same, with Inf
+``stall@R:S``      the feeder thread sleeps S seconds while staging item R
+                   (exercises the consumer-side stall watchdog)
+``feeder_error@R`` the feeder's stage call raises :class:`InjectedFault`
+                   once at item R (exercises the stage retry/backoff path)
+``crash@R``        raise :class:`InjectedFault` in the run loop before
+                   dispatching round R (exercises Supervisor retry-resume)
+``kill@R``         SIGKILL this process before dispatching round R (the
+                   mid-run host kill; exercises ``Job.supervise`` restart)
+``ckpt_corrupt@S`` scribble over the checkpoint payload of Orbax step S
+                   right after it is written (exercises the hash-sidecar
+                   fallback restore)
+``seed=N``         seeds deterministic choices (which worker's batch rows
+                   get poisoned)
+=================  ==========================================================
+
+Cross-process one-shot state: ``kill@R`` restarts the process, which would
+re-fire the kill forever. Set ``DKTPU_FAULTS_STATE=/path/file`` and fired
+faults are journaled there, surviving the restart.
+
+Scheduling caveat: batch faults (``nan``/``inf``) fire at *staging* time,
+and the RoundFeeder stages ``depth`` (default 2) rounds ahead of execution
+— a crash/kill scheduled within that lookahead of a batch fault can
+discard the already-poisoned staged batch, consuming the one-shot with no
+observable effect. Keep batch faults at least ``depth + 1`` rounds away
+from crash/kill faults (the shipped schedules use a gap of 4).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+#: fault kinds and whether they take an argument.
+_KINDS = frozenset({
+    "nan", "inf", "stall", "feeder_error", "crash", "kill", "ckpt_corrupt",
+})
+
+
+class FaultPlan:
+    """A seeded, deterministic schedule of injected faults.
+
+    Thread-safe: the feeder thread (stall/feeder_error), the run loop
+    (nan/crash/kill), and the checkpointer (ckpt_corrupt) all consult one
+    plan concurrently.
+    """
+
+    def __init__(self, faults: Optional[dict] = None, seed: int = 0,
+                 state_file: Optional[str] = None):
+        #: {(kind, at): arg} — arg is None for argless kinds.
+        self.faults: dict = dict(faults or {})
+        self.seed = int(seed)
+        self.state_file = state_file
+        self._fired: set = set()
+        self._lock = threading.Lock()
+        if state_file and os.path.exists(state_file):
+            with open(state_file) as f:
+                self._fired = {tuple(line.strip().rsplit("@", 1))
+                               for line in f if "@" in line}
+            self._fired = {(k, int(at)) for k, at in self._fired}
+
+    @classmethod
+    def parse(cls, spec: str,
+              state_file: Optional[str] = None) -> "FaultPlan":
+        faults: dict = {}
+        seed = 0
+        for entry in spec.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            if entry.startswith("seed="):
+                seed = int(entry[5:])
+                continue
+            if "@" not in entry:
+                raise ValueError(
+                    f"bad DKTPU_FAULTS entry {entry!r}: expected "
+                    "kind@round[:arg] or seed=N")
+            kind, at = entry.split("@", 1)
+            kind = kind.strip()
+            if kind not in _KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r}; known: {sorted(_KINDS)}")
+            arg: Optional[float] = None
+            if ":" in at:
+                at, args = at.split(":", 1)
+                arg = float(args)
+            faults[(kind, int(at))] = arg
+        return cls(faults, seed=seed, state_file=state_file)
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        spec = os.environ.get("DKTPU_FAULTS", "")
+        if not spec.strip():
+            return None
+        return cls.parse(spec, state_file=os.environ.get(
+            "DKTPU_FAULTS_STATE") or None)
+
+    # ------------------------------------------------------------------
+    def _fire(self, kind: str, at: int) -> Optional[float]:
+        """The fault's arg if (kind, at) is scheduled and not yet fired;
+        marks it fired (and journals it) as a side effect."""
+        key = (kind, at)
+        with self._lock:
+            if key not in self.faults or key in self._fired:
+                return None
+            self._fired.add(key)
+            arg = self.faults[key]
+        if self.state_file:
+            # Journal BEFORE the fault takes effect: kill@R must not re-fire
+            # after the restart it causes.
+            with open(self.state_file, "a") as f:
+                f.write(f"{kind}@{at}\n")
+        from distkeras_tpu import telemetry
+
+        telemetry.counter("resilience.faults_injected").add(1)
+        telemetry.event("fault_injected", {"fault": kind, "at": at})
+        return arg if arg is not None else 0.0
+
+    # -- queries (all one-shot) ----------------------------------------
+    def batch_fault(self, round_idx: int) -> Optional[str]:
+        """``"nan"``/``"inf"`` if this round's batch should be poisoned."""
+        for kind in ("nan", "inf"):
+            if self._fire(kind, round_idx) is not None:
+                return kind
+        return None
+
+    def feeder_stall(self, item: int) -> float:
+        """Seconds the feeder should sleep staging ``item`` (0 = no fault)."""
+        arg = self._fire("stall", item)
+        return float(arg) if arg else 0.0
+
+    def feeder_error(self, item: int) -> bool:
+        return self._fire("feeder_error", item) is not None
+
+    def crash(self, round_idx: int) -> bool:
+        return self._fire("crash", round_idx) is not None
+
+    def kill(self, round_idx: int) -> bool:
+        return self._fire("kill", round_idx) is not None
+
+    def ckpt_corrupt(self, step: int) -> bool:
+        return self._fire("ckpt_corrupt", step) is not None
+
+    def poison_worker(self, round_idx: int, num_workers: int) -> int:
+        """Deterministic (seeded) choice of which worker's rows to poison —
+        one worker suffices: its non-finite commit contaminates the psum'd
+        center for everyone, which is exactly the failure mode to test."""
+        if num_workers <= 1:
+            return 0
+        return (self.seed * 1009 + round_idx) % num_workers
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    def __repr__(self) -> str:
+        items = ";".join(
+            f"{k}@{at}" + (f":{arg}" if arg is not None else "")
+            for (k, at), arg in sorted(self.faults.items()))
+        return f"FaultPlan({items!r}, seed={self.seed})"
+
+
+# -- ambient plan (env-driven, cached by spec) -----------------------------
+_LOCK = threading.Lock()
+_CACHED_SPEC: Optional[str] = None
+_CACHED_PLAN: Optional[FaultPlan] = None
+_EXPLICIT: Optional[FaultPlan] = None
+_EXPLICIT_SET = False
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The process-ambient FaultPlan (None when no faults are configured).
+
+    Re-parses when ``DKTPU_FAULTS`` changes (fresh fired-state), otherwise
+    returns the cached plan so one-shot semantics hold across the run. An
+    explicit :func:`set_plan` overrides the environment entirely."""
+    global _CACHED_SPEC, _CACHED_PLAN
+    if _EXPLICIT_SET:
+        return _EXPLICIT
+    spec = os.environ.get("DKTPU_FAULTS", "").strip()
+    if not spec:
+        return None
+    with _LOCK:
+        if spec != _CACHED_SPEC:
+            _CACHED_PLAN = FaultPlan.parse(spec, state_file=os.environ.get(
+                "DKTPU_FAULTS_STATE") or None)
+            _CACHED_SPEC = spec
+        return _CACHED_PLAN
+
+
+def set_plan(plan: Optional[FaultPlan]) -> None:
+    """Install ``plan`` as the ambient plan (tests; programmatic use).
+    ``set_plan(None)`` forces no-faults regardless of the environment."""
+    global _EXPLICIT, _EXPLICIT_SET
+    with _LOCK:
+        _EXPLICIT = plan
+        _EXPLICIT_SET = True
+
+
+def reset() -> None:
+    """Clear the explicit plan and the env cache (the next
+    :func:`active_plan` re-reads ``DKTPU_FAULTS`` with fresh fired-state)."""
+    global _EXPLICIT, _EXPLICIT_SET, _CACHED_SPEC, _CACHED_PLAN
+    with _LOCK:
+        _EXPLICIT = None
+        _EXPLICIT_SET = False
+        _CACHED_SPEC = None
+        _CACHED_PLAN = None

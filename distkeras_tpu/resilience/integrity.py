@@ -1,0 +1,107 @@
+"""Checkpoint integrity: content digests, sidecar files, and (for fault
+injection) controlled corruption.
+
+Orbax detects *some* on-disk damage (missing files, unreadable metadata) but
+a bit-flipped array payload can restore to silent garbage. The digest
+sidecar closes that hole: :class:`~distkeras_tpu.checkpoint.Checkpointer`
+hashes the state at save time, and a verified restore re-hashes and
+compares, falling back to the previous step on mismatch.
+
+Digests are computed from the *encoded* tree (typed PRNG keys already
+converted to raw data), leaf-by-leaf in ``jax.tree`` flatten order with
+dtype and shape mixed in — a silent dtype/shape drift fails the check too.
+Single-process only: hashing requires fully-addressable arrays; multi-host
+runs skip the sidecar (Orbax's own coordination covers the write there).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+
+def tree_digest(tree: Any) -> dict:
+    """A JSON-able content digest of every leaf in ``tree``."""
+    import jax
+
+    h = hashlib.sha256()
+    leaves = jax.tree.leaves(tree)
+    total = 0
+    for leaf in leaves:
+        a = np.ascontiguousarray(np.asarray(leaf))
+        h.update(f"{a.dtype.str}{a.shape}".encode())
+        h.update(a.tobytes())
+        total += a.nbytes
+    return {"algo": "sha256", "hexdigest": h.hexdigest(),
+            "leaves": len(leaves), "bytes": total}
+
+
+def write_digest(path: str, digest: dict) -> None:
+    """Atomic (tmp + rename) sidecar write."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(digest, f)
+    os.replace(tmp, path)
+
+
+def read_digest(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def matches(tree: Any, digest: Optional[dict]) -> bool:
+    """Whether ``tree`` hashes to ``digest`` (vacuously True without one)."""
+    if not digest or "hexdigest" not in digest:
+        return True
+    return tree_digest(tree)["hexdigest"] == digest["hexdigest"]
+
+
+def corrupt_file(path: str, nbytes: int = 64) -> None:
+    """Overwrite ``nbytes`` in the middle of ``path`` with inverted bits —
+    the fault-injection primitive behind ``ckpt_corrupt@S``."""
+    size = os.path.getsize(path)
+    if size == 0:
+        with open(path, "wb") as f:
+            f.write(b"\xff" * nbytes)
+        return
+    off = max(0, size // 2 - nbytes // 2)
+    with open(path, "r+b") as f:
+        f.seek(off)
+        chunk = f.read(min(nbytes, size - off))
+        f.seek(off)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+
+
+def corrupt_step_dir(directory: str) -> Optional[str]:
+    """Corrupt the array payload of a checkpoint step directory. OCDBT
+    keeps data chunks under ``d/`` directories — and may keep duplicate
+    copies (a per-process staging dir plus the merged database), so EVERY
+    chunk file is hit; damaging only one copy would leave the read path
+    intact and inject nothing. Without any ``d/`` dir, the single largest
+    file is corrupted instead. Returns the first path hit (None if the
+    directory is empty)."""
+    chunks: list[str] = []
+    best, best_size = None, -1
+    for root, _dirs, files in os.walk(directory):
+        is_data = os.path.basename(root) == "d"
+        for name in files:
+            path = os.path.join(root, name)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            if is_data:
+                chunks.append(path)
+            elif size > best_size:
+                best, best_size = path, size
+    targets = chunks or ([best] if best is not None else [])
+    for path in targets:
+        corrupt_file(path)
+    return targets[0] if targets else None

@@ -51,18 +51,43 @@ def write_jsonl(tele: Telemetry, path_or_file: Union[str, TextIO],
         _write(path_or_file)
 
 
-def read_jsonl(path: str) -> list[dict]:
-    """All records of a telemetry/metrics JSONL (malformed lines skipped)."""
-    records = []
+def read_jsonl(path: str, strict: bool = False) -> list[dict]:
+    """All records of a telemetry/metrics JSONL.
+
+    Crash-tolerant by design: a process killed mid-append leaves a
+    truncated final line, and post-mortem ``telemetry report`` matters most
+    on exactly those runs — a torn *final* line is always skipped silently,
+    never an error. Malformed *interior* lines are skipped with a warning
+    (they indicate concurrent-writer damage, not a crash); ``strict=True``
+    raises on them instead, still tolerating the torn tail."""
+    records: list[dict] = []
+    bad: list[int] = []
+    # Streaming with a one-line hold-back (these files are exactly the ones
+    # that grow for hours — never slurp them): a malformed line's verdict is
+    # deferred until we know whether anything follows it. Followed by more
+    # content -> interior damage; at EOF -> the torn tail.
+    pending_bad = 0
     with open(path) as f:
-        for line in f:
-            line = line.strip()
+        for i, raw in enumerate(f, 1):
+            if pending_bad:
+                if strict:
+                    raise ValueError(
+                        f"malformed JSONL record at {path}:{pending_bad}")
+                bad.append(pending_bad)
+                pending_bad = 0
+            line = raw.strip()
             if not line:
                 continue
             try:
                 records.append(json.loads(line))
             except ValueError:
-                continue
+                pending_bad = i
+    if bad:
+        import warnings
+
+        warnings.warn(
+            f"{path}: skipped {len(bad)} malformed interior JSONL line(s) "
+            f"(first at line {bad[0]})", stacklevel=2)
     return records
 
 

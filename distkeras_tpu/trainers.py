@@ -201,6 +201,134 @@ class Trainer:
         else:
             self._dtype_override = value
 
+    def _restore_candidate(self, engine, plan, ckpt, step, meta):
+        """Restore checkpoint ``step`` (whose sidecar ``meta`` was already
+        read) onto ``engine``, integrity-verified against the digest sidecar.
+        Returns ``(state, start_round)``; raises on a missing/corrupt
+        payload so :meth:`_resume_from_checkpoint` can fall back."""
+        if not meta:
+            # Orbax steps are offset from rounds across resumes; with
+            # the sidecar gone the raw step is only an upper bound on
+            # the true round. Resume conservatively from it, loudly.
+            warnings.warn(
+                f"checkpoint step {step} has no meta sidecar; "
+                "treating the step as the round index — if this run "
+                "chain was ever resumed or resized, data progress "
+                "may be overestimated", stacklevel=2)
+        true_round = int(meta.get("round", step))
+        saved_w = meta.get("num_workers")
+        cur_w = getattr(engine, "num_workers", None)
+        saved_spr = meta.get("samples_per_round")
+        resized = (saved_w is not None and cur_w is not None
+                   and saved_w != cur_w)
+        # Round indices are meaningless across schedules whose
+        # per-round sample count changed — a worker-count resize,
+        # OR a topology-dependent plan (e.g. a step engine's
+        # per-dp-rank sharded schedule) whose spr moved while the
+        # engine's logical worker count stayed 1.
+        spr_changed = (saved_spr is not None
+                       and saved_spr != plan.samples_per_round)
+        start = 0
+        if resized or spr_changed:
+            # Carry over DATA progress (samples consumed), not the
+            # raw counter. Old checkpoints without samples_per_round
+            # meta fall back to the worker-count ratio (exact when
+            # batch/window are unchanged, the common pod-resize
+            # case).
+            num = saved_spr if saved_spr else saved_w
+            den = plan.samples_per_round if saved_spr else cur_w
+            start = min(((true_round + 1) * num) // den,
+                        plan.num_rounds)
+        if resized and hasattr(engine, "host_state"):
+            # Elastic resume: the checkpoint was written at a
+            # different worker count (pod resize). Restore on the
+            # host at the saved topology, then re-join every worker
+            # from the center (the reference's PS pull semantics).
+            host = ckpt.restore_host(engine.host_state(saved_w),
+                                     step=step, verify=True)
+            state = engine.adopt_state(host)
+        else:
+            state = ckpt.restore(engine.init_state(), step=step, verify=True)
+            if resized:
+                # W-independent state (e.g. SyncEngine) restores
+                # exactly under a resize; data progress still
+                # rescales so the resumed run neither replays nor
+                # skips a topology-dependent slice of the data.
+                warnings.warn(
+                    f"resuming a checkpoint saved with num_workers="
+                    f"{saved_w} on num_workers={cur_w}: state "
+                    "restored exactly; data progress rescaled",
+                    stacklevel=2)
+            elif spr_changed:
+                warnings.warn(
+                    "resuming under a schedule whose samples/round "
+                    f"changed ({saved_spr} -> "
+                    f"{plan.samples_per_round}): state restored "
+                    "exactly; data progress rescaled", stacklevel=2)
+            else:
+                start = min(true_round + 1, plan.num_rounds)
+        return state, start
+
+    def _resume_from_checkpoint(self, engine, plan, ckpt):
+        """Resolve the resume point over ALL retained steps, newest first:
+        steps with an intact meta sidecar are preferred (a missing/corrupt
+        sidecar falls back to the most recent step that has one), and a step
+        whose payload fails to restore or fails its integrity check falls
+        back to the previous step. Returns ``(state, start, step_offset)``;
+        ``state`` is None when nothing was restorable (fresh start)."""
+        from distkeras_tpu import telemetry
+
+        steps = ckpt.steps_desc()
+        with_meta = [s for s in steps if ckpt.meta(s)]
+        candidates = with_meta or steps
+        if with_meta and with_meta[0] != steps[0]:
+            telemetry.counter("resilience.ckpt_fallback_steps").add(1)
+            warnings.warn(
+                f"latest checkpoint step {steps[0]} has a missing/corrupt "
+                f"meta sidecar; falling back to step {with_meta[0]}, the "
+                "most recent step with an intact sidecar", stacklevel=2)
+        last_err = None
+        for step in candidates:
+            meta = ckpt.meta(step) or {}
+            saved_w = meta.get("num_workers")
+            cur_w = getattr(engine, "num_workers", None)
+            if (saved_w is not None and cur_w is not None
+                    and saved_w != cur_w and hasattr(engine, "host_state")):
+                disc = getattr(engine, "discipline", None)
+                if disc is not None and not disc.center_is_trained:
+                    # A configuration error, not corruption: falling back
+                    # to an older step cannot fix a topology mismatch.
+                    raise ValueError(
+                        f"cannot elastically resume {type(disc).__name__}"
+                        " (worker count changed): its training progress"
+                        " lives in the per-worker replicas, not the"
+                        " center. Resume with the original num_workers="
+                        f"{saved_w}.")
+            try:
+                state, start = self._restore_candidate(
+                    engine, plan, ckpt, step, meta)
+            except Exception as e:  # corrupt/unreadable: try the next step
+                last_err = e
+                telemetry.counter("resilience.ckpt_fallback_steps").add(1)
+                telemetry.event("ckpt_fallback", {
+                    "step": step, "error": repr(e)})
+                warnings.warn(
+                    f"checkpoint step {step} failed to restore "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    "previous step", stacklevel=2)
+                continue
+            # Offset past the NEWEST retained step, not the restored one:
+            # after a fallback the skipped (corrupt/sidecar-less) newer
+            # steps are still on disk, and Orbax declines any save at a
+            # step <= latest_step() — offsetting from the restored step
+            # would get every periodic save until the counter passed them
+            # silently declined.
+            return state, start, (steps[0] + 1) - start
+        warnings.warn(
+            f"no restorable checkpoint in {self.checkpoint_dir} "
+            f"(last error: {last_err!r}); starting fresh", stacklevel=2)
+        return None, 0, (steps[0] + 1) if steps else 0
+
     def _execute(self, engine, plan):
         """Shared run harness: resume from checkpoint, per-round metrics/saves."""
         state = None
@@ -220,76 +348,8 @@ class Trainer:
             ckpt = Checkpointer(self.checkpoint_dir)
             latest = ckpt.latest_step()
             if self.resume and latest is not None:
-                meta = ckpt.meta(latest) or {}
-                if not meta:
-                    # Orbax steps are offset from rounds across resumes; with
-                    # the sidecar gone the raw step is only an upper bound on
-                    # the true round. Resume conservatively from it, loudly.
-                    warnings.warn(
-                        f"checkpoint step {latest} has no meta sidecar; "
-                        "treating the step as the round index — if this run "
-                        "chain was ever resumed or resized, data progress "
-                        "may be overestimated", stacklevel=2)
-                true_round = int(meta.get("round", latest))
-                saved_w = meta.get("num_workers")
-                cur_w = getattr(engine, "num_workers", None)
-                saved_spr = meta.get("samples_per_round")
-                resized = (saved_w is not None and cur_w is not None
-                           and saved_w != cur_w)
-                # Round indices are meaningless across schedules whose
-                # per-round sample count changed — a worker-count resize,
-                # OR a topology-dependent plan (e.g. a step engine's
-                # per-dp-rank sharded schedule) whose spr moved while the
-                # engine's logical worker count stayed 1.
-                spr_changed = (saved_spr is not None
-                               and saved_spr != plan.samples_per_round)
-                if resized or spr_changed:
-                    # Carry over DATA progress (samples consumed), not the
-                    # raw counter. Old checkpoints without samples_per_round
-                    # meta fall back to the worker-count ratio (exact when
-                    # batch/window are unchanged, the common pod-resize
-                    # case).
-                    num = saved_spr if saved_spr else saved_w
-                    den = plan.samples_per_round if saved_spr else cur_w
-                    start = min(((true_round + 1) * num) // den,
-                                plan.num_rounds)
-                if resized and hasattr(engine, "host_state"):
-                    # Elastic resume: the checkpoint was written at a
-                    # different worker count (pod resize). Restore on the
-                    # host at the saved topology, then re-join every worker
-                    # from the center (the reference's PS pull semantics).
-                    disc = getattr(engine, "discipline", None)
-                    if disc is not None and not disc.center_is_trained:
-                        raise ValueError(
-                            f"cannot elastically resume {type(disc).__name__}"
-                            " (worker count changed): its training progress"
-                            " lives in the per-worker replicas, not the"
-                            " center. Resume with the original num_workers="
-                            f"{saved_w}.")
-                    host = ckpt.restore_host(engine.host_state(saved_w),
-                                             step=latest)
-                    state = engine.adopt_state(host)
-                else:
-                    state = ckpt.restore(engine.init_state(), step=latest)
-                    if resized:
-                        # W-independent state (e.g. SyncEngine) restores
-                        # exactly under a resize; data progress still
-                        # rescales so the resumed run neither replays nor
-                        # skips a topology-dependent slice of the data.
-                        warnings.warn(
-                            f"resuming a checkpoint saved with num_workers="
-                            f"{saved_w} on num_workers={cur_w}: state "
-                            "restored exactly; data progress rescaled",
-                            stacklevel=2)
-                    elif spr_changed:
-                        warnings.warn(
-                            "resuming under a schedule whose samples/round "
-                            f"changed ({saved_spr} -> "
-                            f"{plan.samples_per_round}): state restored "
-                            "exactly; data progress rescaled", stacklevel=2)
-                    else:
-                        start = min(true_round + 1, plan.num_rounds)
-                step_offset = (latest + 1) - start
+                state, start, step_offset = self._resume_from_checkpoint(
+                    engine, plan, ckpt)
             elif latest is not None:
                 # Fresh run (resume=False) into a dir with prior checkpoints:
                 # rounds restart at 0, so without an offset every save would
@@ -523,9 +583,15 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
     communication_window = _config_prop("communication_window")
 
     def __init__(self, *args, communication_window: int = 5,
-                 parallel: Optional[dict] = None, rules=None, **kwargs):
+                 parallel: Optional[dict] = None, rules=None,
+                 divergence_reset: Optional[float] = None, **kwargs):
         super().__init__(*args, **kwargs)
         self.config = self.config.replace(communication_window=communication_window)
+        #: resilience: |worker loss − mean| beyond this threshold re-adopts
+        #: the center for that worker (fresh optimizer, reference PS-pull
+        #: semantics). None (default) = off; fetches the loss every round
+        #: when on. Env override: DKTPU_DIVERGENCE_RESET.
+        self.divergence_reset = divergence_reset
         #: each async worker as a model-parallel submesh:
         #: ``parallel={"model": 2}`` makes every logical worker a tp=2
         #: tensor-parallel replica (AsyncTPEngine over a (data, model)
@@ -586,6 +652,7 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
             compute_dtype=self.compute_dtype, seed=self.seed,
             grad_accum=self.grad_accum,
             device_transform=self.device_transform,
+            divergence_reset=self.divergence_reset,
         )
 
     def _run(self, dataframe: DataFrame, shuffle: bool):
@@ -601,6 +668,7 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
                 compute_dtype=self.compute_dtype, seed=self.seed,
                 grad_accum=self.grad_accum, workers_per_chip=m,
                 device_transform=self.device_transform,
+                divergence_reset=self.divergence_reset,
             )
         plan = make_batches(
             dataframe, self.features_col, self.label_col, self.batch_size,

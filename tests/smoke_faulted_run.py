@@ -1,0 +1,68 @@
+"""CI fault-injection smoke (not a pytest module — run directly).
+
+An end-to-end, *env-driven* supervised run: CI invokes it with
+``DKTPU_FAULTS="nan@1;stall@3:0.2;crash@5"`` set (see
+``.github/workflows/tier1.yml``) and asserts the run completes, every
+scheduled fault actually fired, and accuracy survived — the path a user
+hits when they set ``DKTPU_FAULTS`` by hand, exercised without pytest
+fixtures in the way.
+
+    DKTPU_FAULTS="nan@1;stall@3:0.2;crash@5" python tests/smoke_faulted_run.py
+"""
+
+import os
+import sys
+import tempfile
+import warnings
+
+# Runs from a checkout without installation: sys.path[0] is tests/, so the
+# repo root must be appended (an installed distkeras_tpu still wins).
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from distkeras_tpu import ADAG, DataFrame, Supervisor, telemetry  # noqa: E402
+from distkeras_tpu.models import Model  # noqa: E402
+from distkeras_tpu.models.mlp import MLP  # noqa: E402
+
+
+def main() -> int:
+    n_faults = len([e for e in os.environ.get("DKTPU_FAULTS", "").split(";")
+                    if e.strip() and not e.strip().startswith("seed=")])
+    rng = np.random.default_rng(0)
+    centers = rng.normal(scale=4.0, size=(3, 4))
+    y = rng.integers(0, 3, size=1024)
+    x = centers[y] + rng.normal(scale=0.5, size=(1024, 4))
+    df = DataFrame({"features": x.astype(np.float32),
+                    "label": y.astype(np.int32)})
+    model = Model.build(MLP(hidden=(16,), num_outputs=3),
+                        jnp.zeros((1, 4), jnp.float32), seed=0)
+    trainer = ADAG(model, loss="sparse_categorical_crossentropy",
+                   num_workers=4, batch_size=16, num_epoch=3,
+                   learning_rate=0.1, communication_window=4,
+                   checkpoint_dir=tempfile.mkdtemp(prefix="dktpu-smoke-"),
+                   checkpoint_every=1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        trained = Supervisor(trainer, max_retries=3, backoff_s=0).train(
+            df, shuffle=True)
+    acc = float((np.asarray(trained.predict(jnp.asarray(
+        df["features"]))).argmax(-1) == df["label"]).mean())
+    injected = telemetry.get().counter("resilience.faults_injected").value
+    print(f"supervised faulted run: acc={acc:.4f} "
+          f"faults_injected={injected:.0f}/{n_faults}")
+    assert acc > 0.85, f"accuracy collapsed under injected faults: {acc}"
+    assert injected == n_faults, (injected, n_faults)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -161,6 +161,40 @@ def test_raced_ps_matches_window_folds(discipline):
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("discipline", ["adag", "aeasgd"])
+def test_window_folds_with_faults_match_raced(discipline, tmp_path,
+                                              monkeypatch):
+    """Accuracy parity survives injected faults (ISSUE 2 fault matrix): the
+    windowed run takes a NaN-poisoned round (skipped by the on-device
+    guard), a feeder stall, and a mid-run crash (resumed by the Supervisor
+    from checkpoint) — and must still land within noise of the raced PS."""
+    from distkeras_tpu import Supervisor, resilience
+
+    raced, windowed = [], []
+    for seed in (0, 1):
+        acc_r, _ = _raced_accuracy(seed, discipline)
+        resilience.reset()
+        monkeypatch.setenv("DKTPU_FAULTS", "nan@1;stall@2:0.1;crash@3")
+        x, y = _blobs(seed)
+        df = DataFrame({"features": x, "label": y})
+        t = _TRAINERS[discipline](_model(seed))
+        t.checkpoint_dir = str(tmp_path / f"ck-{discipline}-{seed}")
+        t.checkpoint_every = 1
+        with pytest.warns(UserWarning):  # the supervisor's retry notice
+            trained = Supervisor(t, max_retries=2, backoff_s=0).train(
+                df, shuffle=True)
+        resilience.reset()
+        acc_w = _accuracy(trained.predict, x, y)
+        raced.append(acc_r)
+        windowed.append(acc_w)
+    raced, windowed = np.asarray(raced), np.asarray(windowed)
+    assert (raced > 0.85).all(), f"raced failed to converge: {raced}"
+    assert (windowed > 0.85).all(), (
+        f"faulted windowed run failed to converge: {windowed}")
+    assert abs(raced.mean() - windowed.mean()) < 0.05, (raced, windowed)
+
+
+@pytest.mark.slow
 def test_raced_elastic_staleness_is_real():
     """The elastic race genuinely interleaves: with the first-round barrier,
     some AEASGD commit lands against a center that moved since its pull

@@ -1,0 +1,472 @@
+"""Fault-matrix tests for the resilience subsystem (ISSUE 2).
+
+Every recovery path is exercised against a *deterministically injected*
+fault, not asserted: NaN/Inf rounds against the on-device round skip,
+feeder stalls/errors against the watchdog + stage retry, corrupt and
+sidecar-less checkpoints against the fallback restore, in-process crashes
+against the Supervisor's retry-with-resume, and host failures against
+``Job``'s SIGTERM→SIGKILL escalation, wait-expiry teardown, per-host
+restart, and straggler kill.
+"""
+
+import os
+import subprocess
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distkeras_tpu import ADAG, DataFrame, Supervisor, resilience, telemetry
+from distkeras_tpu.data.prefetch import RoundFeeder
+from distkeras_tpu.job_deployment import Job, Punchcard
+from distkeras_tpu.models import Model
+from distkeras_tpu.models.mlp import MLP
+from distkeras_tpu.resilience import FaultPlan
+from distkeras_tpu.resilience import integrity
+from distkeras_tpu.resilience.errors import FeederStalledError, InjectedFault
+
+N, DIM, C = 1024, 4, 3
+#: ADAG config: 4 workers x window 4 x batch 16 over 1024 rows x 3 epochs
+#: = 12 fold rounds — enough room for the r=3 / r=5 / r=7 fault schedule.
+COMMON = dict(loss="sparse_categorical_crossentropy", batch_size=16,
+              num_epoch=3, learning_rate=0.1, num_workers=4,
+              communication_window=4)
+NUM_ROUNDS = 12
+
+
+@pytest.fixture(autouse=True)
+def _fault_hygiene(monkeypatch):
+    """Fresh ambient fault-plan state per test; no env leakage."""
+    for var in ("DKTPU_FAULTS", "DKTPU_FAULTS_STATE", "DKTPU_NAN_GUARD",
+                "DKTPU_FEEDER_TIMEOUT", "DKTPU_FEEDER_WARN",
+                "DKTPU_FEEDER_RETRIES", "DKTPU_DIVERGENCE_RESET"):
+        monkeypatch.delenv(var, raising=False)
+    resilience.reset()
+    yield
+    resilience.reset()
+
+
+def blob_df(seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=4.0, size=(C, DIM))
+    y = rng.integers(0, C, size=N)
+    x = centers[y] + rng.normal(scale=0.5, size=(N, DIM))
+    return DataFrame({"features": x.astype(np.float32),
+                      "label": y.astype(np.int32)})
+
+
+def tiny_model(seed=0):
+    return Model.build(MLP(hidden=(16,), num_outputs=C),
+                       jnp.zeros((1, DIM), jnp.float32), seed=seed)
+
+
+def accuracy(model, df):
+    logits = np.asarray(model.predict(jnp.asarray(df["features"])))
+    return float((logits.argmax(-1) == df["label"]).mean())
+
+
+def counter(name):
+    return telemetry.get().counter(name).value
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_parse_and_one_shot():
+    plan = FaultPlan.parse("nan@3;stall@5:0.25;crash@7;kill@9;seed=11")
+    assert plan.seed == 11
+    assert plan.batch_fault(2) is None
+    assert plan.batch_fault(3) == "nan"
+    assert plan.batch_fault(3) is None  # one-shot: never re-fires
+    assert plan.feeder_stall(5) == 0.25
+    assert plan.feeder_stall(5) == 0.0
+    assert plan.crash(7) and not plan.crash(7)
+    assert plan.kill(9) is True  # query only; nobody dies here
+    # seeded worker choice is deterministic
+    assert plan.poison_worker(3, 4) == FaultPlan.parse(
+        "nan@3;seed=11").poison_worker(3, 4)
+
+
+def test_fault_plan_rejects_bad_specs():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultPlan.parse("frobnicate@3")
+    with pytest.raises(ValueError, match="expected kind@round"):
+        FaultPlan.parse("nan3")
+
+
+def test_fault_plan_state_file_survives_restart(tmp_path):
+    state = str(tmp_path / "fired")
+    plan = FaultPlan.parse("kill@7", state_file=state)
+    assert plan.kill(7) is True
+    # a "restarted process" re-parses the same spec + state file
+    plan2 = FaultPlan.parse("kill@7", state_file=state)
+    assert plan2.kill(7) is False
+
+
+# ---------------------------------------------------------------------------
+# NaN/Inf guard (on-device round skip)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["nan", "inf"])
+def test_poisoned_round_skipped_accuracy_parity(monkeypatch, kind):
+    df = blob_df()
+    clean = ADAG(tiny_model(), **COMMON)
+    acc_clean = accuracy(clean.train(df, shuffle=True), df)
+
+    resilience.reset()
+    monkeypatch.setenv("DKTPU_FAULTS", f"{kind}@2")
+    before = counter("resilience.nonfinite_rounds")
+    t = ADAG(tiny_model(), **COMMON)
+    trained = t.train(df, shuffle=True)
+    h = t.get_history()
+    # the poisoned round is visible in the history...
+    assert not np.isfinite(h[2]), h
+    # ...but the state skipped it: training continues and converges
+    assert np.isfinite(h[3:]).all(), h
+    acc = accuracy(trained, df)
+    assert acc > 0.85 and abs(acc - acc_clean) < 0.05, (acc, acc_clean)
+    assert counter("resilience.nonfinite_rounds") - before >= 1
+
+
+def test_nan_guard_disabled_poisons_the_run(monkeypatch):
+    """The counterfactual: without the guard, one worker's NaN round
+    contaminates the psum'd center forever — proof the guard is load-bearing,
+    not decorative."""
+    monkeypatch.setenv("DKTPU_NAN_GUARD", "0")
+    monkeypatch.setenv("DKTPU_FAULTS", "nan@1")
+    t = ADAG(tiny_model(), **COMMON)
+    t.train(blob_df(), shuffle=True)
+    h = t.get_history()
+    assert np.isfinite(h[0])
+    assert not np.isfinite(h[1:]).any(), h
+
+
+def test_blocked_mode_poisoned_round_also_skipped(monkeypatch):
+    """rounds_per_program > 1: the fault lands inside a compiled block and
+    the in-scan guard still skips exactly that round."""
+    monkeypatch.setenv("DKTPU_FAULTS", "nan@2")
+    t = ADAG(tiny_model(), rounds_per_program=4, **COMMON)
+    trained = t.train(blob_df(), shuffle=True)
+    h = t.get_history()
+    assert not np.isfinite(h[2]) and np.isfinite(h[3:]).all(), h
+    assert accuracy(trained, blob_df()) > 0.85
+
+
+# ---------------------------------------------------------------------------
+# Feeder: stall watchdog + stage retry
+# ---------------------------------------------------------------------------
+
+def test_feeder_stall_watchdog_warns(monkeypatch):
+    monkeypatch.setenv("DKTPU_FAULTS", "stall@1:0.4")
+    before = counter("resilience.feeder_stall_warnings")
+    feeder = RoundFeeder(3, lambda r: r, stall_warn=0.05, stall_timeout=10.0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        got = [r for r, _ in feeder]
+    assert got == [0, 1, 2]
+    assert counter("resilience.feeder_stall_warnings") - before >= 1
+
+
+def test_feeder_stall_timeout_declares_pipeline_dead():
+    def stage(r):
+        if r == 1:
+            time.sleep(2.0)
+        return r
+
+    feeder = RoundFeeder(3, stage, stall_warn=0.05, stall_timeout=0.3)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(FeederStalledError, match="stall_timeout"):
+            list(feeder)
+    feeder.close()
+
+
+def test_feeder_error_retry_recovers(monkeypatch):
+    monkeypatch.setenv("DKTPU_FAULTS", "feeder_error@1")
+    before = counter("resilience.feeder_retries")
+    feeder = RoundFeeder(3, lambda r: r, stage_retries=1)
+    got = [r for r, _ in feeder]
+    assert got == [0, 1, 2]  # the one-shot fault consumed by the retry
+    assert counter("resilience.feeder_retries") - before == 1
+
+
+def test_feeder_persistent_error_still_propagates():
+    def stage(r):
+        if r == 1:
+            raise ValueError("disk on fire")
+        return r
+
+    feeder = RoundFeeder(3, stage, stage_retries=2, retry_backoff_s=0.01)
+    with pytest.raises(ValueError, match="disk on fire"):
+        list(feeder)
+    feeder.close()
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint integrity + fallback
+# ---------------------------------------------------------------------------
+
+def test_tree_digest_detects_tamper():
+    tree = {"w": np.arange(8, dtype=np.float32), "b": np.zeros(3)}
+    digest = integrity.tree_digest(tree)
+    assert integrity.matches(tree, digest)
+    tampered = {"w": tree["w"].copy(), "b": tree["b"]}
+    tampered["w"][3] += 1e-3
+    assert not integrity.matches(tampered, digest)
+    # dtype drift is damage too
+    assert not integrity.matches(
+        {"w": tree["w"].astype(np.float64), "b": tree["b"]}, digest)
+
+
+def _train_with_checkpoints(tmp_path, **extra):
+    df = blob_df()
+    t = ADAG(tiny_model(), checkpoint_dir=str(tmp_path / "ck"),
+             checkpoint_every=1, **COMMON, **extra)
+    t.train(df, shuffle=True)
+    return df, t
+
+
+def test_corrupt_checkpoint_falls_back_to_previous_step(tmp_path,
+                                                        monkeypatch):
+    pytest.importorskip("orbax.checkpoint")
+    # ckpt_corrupt@11 fires right after the final round's save lands.
+    monkeypatch.setenv("DKTPU_FAULTS", f"ckpt_corrupt@{NUM_ROUNDS - 1}")
+    df, _ = _train_with_checkpoints(tmp_path)
+    resilience.reset()
+    monkeypatch.delenv("DKTPU_FAULTS")
+
+    before = counter("resilience.ckpt_fallback_steps")
+    t2 = ADAG(tiny_model(), checkpoint_dir=str(tmp_path / "ck"),
+              checkpoint_every=1, resume=True, **COMMON)
+    with pytest.warns(UserWarning, match="falling back to the previous"):
+        t2.train(df, shuffle=True)
+    assert counter("resilience.ckpt_fallback_steps") - before >= 1
+    # resumed from step 10 (round 10) -> exactly one round left to run
+    assert len(t2.get_history()) == 1
+
+
+def test_missing_meta_sidecar_falls_back_to_intact_step(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    df, _ = _train_with_checkpoints(tmp_path)
+    from distkeras_tpu.checkpoint import Checkpointer
+
+    latest = Checkpointer(str(tmp_path / "ck")).latest_step()
+    os.remove(tmp_path / "ck" / "meta" / f"{latest}.json")
+
+    t2 = ADAG(tiny_model(), checkpoint_dir=str(tmp_path / "ck"),
+              checkpoint_every=1, resume=True, **COMMON)
+    with pytest.warns(UserWarning, match="intact sidecar"):
+        t2.train(df, shuffle=True)
+    # resumed from the previous step's recorded round, not from scratch and
+    # not from the raw latest step
+    assert len(t2.get_history()) == NUM_ROUNDS - latest
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: retry-with-resume
+# ---------------------------------------------------------------------------
+
+def test_supervisor_resumes_after_crash(tmp_path, monkeypatch):
+    pytest.importorskip("orbax.checkpoint")
+    monkeypatch.setenv("DKTPU_FAULTS", "crash@7")
+    df = blob_df()
+    before = counter("resilience.supervisor_retries")
+    t = ADAG(tiny_model(), checkpoint_dir=str(tmp_path / "ck"),
+             checkpoint_every=1, **COMMON)
+    sup = Supervisor(t, max_retries=2, backoff_s=0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        trained = sup.train(df, shuffle=True)
+    assert sup.attempts == 2
+    assert counter("resilience.supervisor_retries") - before == 1
+    assert accuracy(trained, df) > 0.85
+    # the resumed attempt picked up mid-run, it did not replay from round 0
+    assert len(t.get_history()) < NUM_ROUNDS
+
+
+def test_supervisor_budget_is_bounded(monkeypatch):
+    monkeypatch.setenv("DKTPU_FAULTS", "crash@0;crash@1")
+    t = ADAG(tiny_model(), **COMMON)  # no checkpoint_dir: restart from 0
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        sup = Supervisor(t, max_retries=1, backoff_s=0)
+        with pytest.raises(InjectedFault):
+            sup.train(blob_df(), shuffle=True)
+    assert sup.attempts == 2
+
+
+def test_supervised_fault_matrix_accuracy_parity(tmp_path, monkeypatch):
+    """The acceptance scenario: NaN round at r=3, feeder stall at r=5, crash
+    at r=7 — a supervised ADAG run completes, resumes from checkpoint within
+    the retry budget, and final accuracy matches the fault-free run."""
+    pytest.importorskip("orbax.checkpoint")
+    df = blob_df()
+    clean = ADAG(tiny_model(), **COMMON)
+    acc_clean = accuracy(clean.train(df, shuffle=True), df)
+
+    resilience.reset()
+    monkeypatch.setenv("DKTPU_FAULTS", "nan@3;stall@5:0.2;crash@7")
+    monkeypatch.setenv("DKTPU_FEEDER_WARN", "0.05")
+    c0 = {k: counter(k) for k in ("resilience.nonfinite_rounds",
+                                  "resilience.supervisor_retries",
+                                  "resilience.faults_injected")}
+    t = ADAG(tiny_model(), checkpoint_dir=str(tmp_path / "ck"),
+             checkpoint_every=1, **COMMON)
+    sup = Supervisor(t, max_retries=3, backoff_s=0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        trained = sup.train(df, shuffle=True)
+    acc = accuracy(trained, df)
+    assert acc > 0.85 and abs(acc - acc_clean) < 0.05, (acc, acc_clean)
+    assert sup.attempts == 2  # one crash, one resume
+    assert counter("resilience.nonfinite_rounds") - c0[
+        "resilience.nonfinite_rounds"] >= 1
+    # NOT asserted: feeder_stall_warnings. Whether the 0.2s stall surfaces
+    # as a consumer-visible wait depends on how fast the run loop drains the
+    # lookahead queue (a slow round hides the stall entirely — the
+    # feed-overlap design working as intended). The watchdog's warning path
+    # is covered deterministically by test_feeder_stall_watchdog_warns.
+    assert counter("resilience.supervisor_retries") - c0[
+        "resilience.supervisor_retries"] == 1
+    assert counter("resilience.faults_injected") - c0[
+        "resilience.faults_injected"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Divergent-worker reset
+# ---------------------------------------------------------------------------
+
+def test_reset_workers_readopts_center():
+    from distkeras_tpu.parallel.disciplines import ADAGFold
+    from distkeras_tpu.parallel.engine import AsyncEngine
+    from distkeras_tpu.runtime.mesh import data_mesh
+
+    eng = AsyncEngine(tiny_model(), "sgd", "sparse_categorical_crossentropy",
+                      ADAGFold(), data_mesh(num_workers=4), window=4)
+    st = eng.init_state()
+    drifted = st._replace(
+        locals_=jax.tree.map(lambda a: a + 1.0, st.locals_))
+    mask = np.array([True, False, False, False])
+    st2 = eng.reset_workers(drifted, mask)
+    for loc, cen in zip(jax.tree.leaves(jax.device_get(st2.locals_)),
+                        jax.tree.leaves(jax.device_get(st2.center))):
+        np.testing.assert_allclose(loc[0], cen)       # reset: re-adopted
+        np.testing.assert_allclose(loc[1], cen + 1.0)  # untouched drift
+
+
+def test_divergent_worker_reset_fires_on_poisoned_worker(monkeypatch):
+    """One worker's loss goes non-finite (the round itself is skipped by the
+    NaN guard); the divergence policy re-adopts the center for exactly that
+    worker and training converges."""
+    monkeypatch.setenv("DKTPU_FAULTS", "nan@2")
+    before = counter("resilience.worker_resets")
+    t = ADAG(tiny_model(), divergence_reset=1000.0, **COMMON)
+    trained = t.train(blob_df(), shuffle=True)
+    assert counter("resilience.worker_resets") - before == 1
+    assert accuracy(trained, blob_df()) > 0.85
+
+
+# ---------------------------------------------------------------------------
+# Job: kill escalation, wait teardown, restart, stragglers
+# ---------------------------------------------------------------------------
+
+def _job(script, tmp_path, hosts=1, args=()):
+    return Job(Punchcard(job_name="resilience-test", script=str(script),
+                         hosts=["localhost"] * hosts, args=list(args)))
+
+
+def _wait_for(path, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_job_kill_escalates_for_sigterm_ignorers(tmp_path):
+    script = tmp_path / "stubborn.py"
+    script.write_text(
+        "import signal, sys, time\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        "open(sys.argv[1], 'w').write('up')\n"
+        "time.sleep(60)\n")
+    ready = tmp_path / "ready"
+    job = _job(script, tmp_path, args=[str(ready)])
+    job.launch(dry_run=False)
+    assert _wait_for(ready), "child never came up"
+    t0 = time.monotonic()
+    job.kill(grace=0.5)
+    assert time.monotonic() - t0 < 10.0
+    assert job.poll() == [-9]  # SIGTERM ignored -> escalated to SIGKILL
+
+
+def test_job_wait_timeout_kills_stragglers(tmp_path):
+    script = tmp_path / "sleeper.py"
+    script.write_text("import time\ntime.sleep(60)\n")
+    job = _job(script, tmp_path)
+    job.launch(dry_run=False)
+    with pytest.raises(subprocess.TimeoutExpired):
+        job.wait(timeout=0.5)
+    # the expired wait tore the straggler down instead of leaving it running
+    assert all(rc is not None for rc in job.poll())
+
+
+def test_job_supervise_restarts_failed_host(tmp_path):
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import sys\n"
+        "from pathlib import Path\n"
+        "marker, done = Path(sys.argv[1]), Path(sys.argv[2])\n"
+        "if not marker.exists():\n"
+        "    marker.write_text('x'); sys.exit(1)\n"
+        "done.write_text('done'); sys.exit(0)\n")
+    marker, done = tmp_path / "marker", tmp_path / "done"
+    job = _job(script, tmp_path, args=[str(marker), str(done)])
+    job.launch(dry_run=False)
+    rcs = job.supervise(timeout=60, max_restarts=1, restart_backoff=0.01)
+    assert rcs == [0]
+    assert job.restarts == [1]
+    assert done.exists()
+
+
+def test_job_supervise_kills_stragglers(tmp_path):
+    script = tmp_path / "skewed.py"
+    script.write_text(
+        "import os, time\n"
+        "if os.environ.get('JAX_PROCESS_ID') != '0':\n"
+        "    time.sleep(60)\n")
+    job = _job(script, tmp_path, hosts=2)
+    job.launch(dry_run=False)
+    t0 = time.monotonic()
+    rcs = job.supervise(timeout=60, straggler_timeout=0.5)
+    assert time.monotonic() - t0 < 30.0
+    assert rcs[0] == 0 and rcs[1] not in (None, 0), rcs
+
+
+# ---------------------------------------------------------------------------
+# Telemetry JSONL crash tolerance (exporters satellite)
+# ---------------------------------------------------------------------------
+
+def test_read_jsonl_tolerates_truncated_tail(tmp_path):
+    from distkeras_tpu.telemetry.exporters import read_jsonl
+
+    path = tmp_path / "run.jsonl"
+    path.write_text('{"round": 0, "loss": 1.0}\n'
+                    '{"round": 1, "loss": 0.5}\n'
+                    '{"round": 2, "lo')  # killed mid-append
+    assert len(read_jsonl(str(path))) == 2
+    # strict mode still tolerates the torn tail...
+    assert len(read_jsonl(str(path), strict=True)) == 2
+    # ...but an interior malformed line is real damage
+    path.write_text('{"round": 0}\nGARBAGE\n{"round": 1}\n')
+    with pytest.warns(UserWarning, match="malformed interior"):
+        assert len(read_jsonl(str(path))) == 2
+    with pytest.raises(ValueError, match="malformed JSONL"):
+        read_jsonl(str(path), strict=True)
