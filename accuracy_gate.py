@@ -9,7 +9,8 @@ discipline), and **sync-DP**, with matched sample budgets, at a W=8
 multiplexed-on-one-chip topology (window 8, global batch 1024; the
 throughput bench retuned its B separately — architecture and discipline
 are what the accuracy claim needs), across >= 3 seeds — final held-out
-accuracy must agree within epsilon. One chip suffices: this is an accuracy claim, not a scaling claim.
+accuracy must agree within epsilon. One chip suffices: this is an
+accuracy claim, not a scaling claim.
 
 Writes ``ACCURACY_r05.json`` (the committed artifact) and prints it. The
 CIFAR-10 source is ``datasets.cifar10``: real data when present in

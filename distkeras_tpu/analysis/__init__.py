@@ -1,0 +1,26 @@
+"""dk-check: repo-aware static analysis for distkeras_tpu.
+
+Three rule families over a plain-AST pass (no imports of the analyzed
+code), run as ``python -m distkeras_tpu.analysis [paths]``:
+
+* **DK1xx** (``rules_jax``) — JAX purity/retrace hazards: env/time/random
+  reads, host I/O, or telemetry calls inside jitted/shard_map'd code,
+  non-hashable static args, trace-time mutation of enclosing state.
+* **DK2xx** (``rules_concurrency``) — host-thread hazards: lock-order
+  cycles, unlocked writes to lock-guarded attributes, leaked non-daemon
+  threads, KeyboardInterrupt-swallowing except handlers. The static lock
+  graph is cross-checked at runtime by :mod:`.witness`.
+* **DK3xx** (``rules_config``) — env discipline: ``os.environ`` confined to
+  ``runtime/config.py``, every ``DKTPU_*`` name declared in
+  ``ENV_REGISTRY``, docs tables generated from the registry.
+
+Suppress a finding with ``# dk: disable=DK204`` on its line (justify in the
+comment); catalog and how-to in docs/ANALYSIS.md. CI
+(``.github/workflows/tier1.yml`` job ``static-analysis``) fails on any
+non-suppressed finding.
+"""
+
+from distkeras_tpu.analysis.core import (  # noqa: F401
+    Finding, RULE_CATALOG, render, run)
+from distkeras_tpu.analysis.witness import (  # noqa: F401
+    LockOrderWitness, witness)

@@ -1,0 +1,83 @@
+"""dk-check CLI: ``python -m distkeras_tpu.analysis [paths...]``.
+
+Exit status 0 = clean, 1 = findings, 2 = usage error. See docs/ANALYSIS.md
+for the rule catalog and suppression syntax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from distkeras_tpu.analysis import core
+
+
+def _write_env_docs(repo_root: str) -> int:
+    from distkeras_tpu.runtime import config
+
+    docs_dir = os.path.join(repo_root, "docs")
+    changed = 0
+    for name in sorted(os.listdir(docs_dir)):
+        if not name.endswith(".md"):
+            continue
+        path = os.path.join(docs_dir, name)
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        try:
+            fresh = config.splice_env_docs(text)
+        except ValueError:
+            continue
+        if fresh != text:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(fresh)
+            print(f"dk-check: rewrote env table(s) in {path}")
+            changed += 1
+    if not changed:
+        print("dk-check: env docs already in sync")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m distkeras_tpu.analysis",
+        description="dk-check: repo-aware static analysis "
+                    "(DK1xx jax purity, DK2xx concurrency, DK3xx config)")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/directories to check "
+                             "(default: the distkeras_tpu package)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--select", action="append", default=None,
+                        metavar="DKxxx", help="only rules with this ID prefix "
+                        "(repeatable, e.g. --select DK2 --select DK301)")
+    parser.add_argument("--ignore", action="append", default=None,
+                        metavar="DKxxx", help="drop rules with this ID prefix")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    parser.add_argument("--write-env-docs", action="store_true",
+                        help="regenerate the env-var tables in docs/*.md "
+                             "from runtime.config.ENV_REGISTRY and exit")
+    args = parser.parse_args(argv)
+
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if args.write_env_docs:
+        return _write_env_docs(os.path.dirname(pkg_dir))
+    if args.list_rules:
+        core._load_rules()
+        for rule in sorted(core.RULE_CATALOG):
+            print(f"{rule}  {core.RULE_CATALOG[rule].summary}")
+        return 0
+
+    paths = args.paths or [pkg_dir]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"dk-check: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    findings = core.run(paths, select=args.select, ignore=args.ignore)
+    print(core.render(findings, args.format))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

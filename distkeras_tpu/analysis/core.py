@@ -1,0 +1,225 @@
+"""dk-check core: findings, suppressions, the rule registry, the runner.
+
+The analyzer is a plain-AST pass (no imports of the analyzed code, so a
+broken module still gets checked) with three repo-specific rule families:
+
+* ``DK1xx`` — JAX purity/retrace hazards (``rules_jax``)
+* ``DK2xx`` — host-thread concurrency hazards (``rules_concurrency``)
+* ``DK3xx`` — environment/config discipline (``rules_config``)
+
+Two rule shapes exist: **module rules** see one parsed file at a time;
+**project rules** see the whole file set (the lock-order graph and the
+registry/docs cross-checks need global state).
+
+Suppression: a ``# dk: disable=DK101`` (or ``# dk: disable=DK101,DK204``,
+or blanket ``# dk: disable``) comment suppresses findings attributed to
+that physical line; ``# dk: disable-file=DK301`` anywhere suppresses the
+rule for the whole file. Suppressions are part of the code under review —
+each one should carry a justification in the surrounding comment.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Iterable, Optional
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dk:\s*(?P<kind>disable(?:-file)?)\s*(?:=\s*(?P<rules>[\w,\s]+))?")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    path: str      # as given to the runner (relative when inputs were)
+    line: int
+    col: int
+    rule: str      # stable ID, e.g. "DK101"
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Module:
+    """One parsed source file handed to the rules."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        #: line -> set of suppressed rule IDs (empty set = all rules)
+        self.suppressions: dict = {}
+        #: rules suppressed for the whole file
+        self.file_suppressions: set = set()
+        self._parse_suppressions()
+
+    def _parse_suppressions(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            comments = [(t.start[0], t.string) for t in tokens
+                        if t.type == tokenize.COMMENT]
+        except tokenize.TokenError:
+            comments = [(i + 1, line[line.index("#"):])
+                        for i, line in enumerate(self.source.splitlines())
+                        if "#" in line]
+        for line, text in comments:
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip().upper() for r in (m.group("rules") or "").split(",")
+                     if r.strip()}
+            if m.group("kind") == "disable-file":
+                self.file_suppressions |= rules or {"*"}
+            else:
+                self.suppressions.setdefault(line, set()).update(rules or {"*"})
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if self.file_suppressions & {rule, "*"}:
+            return True
+        rules = self.suppressions.get(line)
+        return rules is not None and bool(rules & {rule, "*"})
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleInfo:
+    """Catalog entry: what ``--list-rules`` and docs/ANALYSIS.md print."""
+
+    rule: str
+    summary: str
+
+
+#: (checker, [RuleInfo]) pairs; module checkers take one Module, project
+#: checkers take the full list.
+_MODULE_CHECKERS: list = []
+_PROJECT_CHECKERS: list = []
+RULE_CATALOG: dict = {}
+
+
+def module_rule(*infos: RuleInfo):
+    def deco(fn):
+        _MODULE_CHECKERS.append(fn)
+        for i in infos:
+            RULE_CATALOG[i.rule] = i
+        return fn
+    return deco
+
+
+def project_rule(*infos: RuleInfo):
+    def deco(fn):
+        _PROJECT_CHECKERS.append(fn)
+        for i in infos:
+            RULE_CATALOG[i.rule] = i
+        return fn
+    return deco
+
+
+def _load_rules() -> None:
+    # Import for registration side effects; idempotent.
+    from distkeras_tpu.analysis import (  # noqa: F401
+        rules_concurrency, rules_config, rules_jax)
+
+
+def iter_py_files(paths: Iterable[str]) -> list:
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+    return out
+
+
+def parse_modules(paths: Iterable[str]) -> tuple:
+    """(modules, findings) — a syntactically broken file becomes a DK000
+    finding instead of crashing the run."""
+    modules, findings = [], []
+    for path in iter_py_files(paths):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            modules.append(Module(path, source))
+        except SyntaxError as e:
+            findings.append(Finding(path, e.lineno or 1, (e.offset or 1) - 1,
+                                    "DK000", f"syntax error: {e.msg}"))
+    return modules, findings
+
+
+def _rule_selected(rule: str, select, ignore) -> bool:
+    if select and not any(rule.startswith(s) for s in select):
+        return False
+    if ignore and any(rule.startswith(s) for s in ignore):
+        return False
+    return True
+
+
+def run(paths: Iterable[str], select: Optional[Iterable[str]] = None,
+        ignore: Optional[Iterable[str]] = None) -> list:
+    """Run every registered rule over ``paths``; returns sorted, unsuppressed
+    findings. ``select``/``ignore`` filter by rule-ID prefix (``DK2``,
+    ``DK201``)."""
+    _load_rules()
+    select = [s.upper() for s in select] if select else None
+    ignore = [s.upper() for s in ignore] if ignore else None
+    modules, findings = parse_modules(paths)
+    by_path = {m.path: m for m in modules}
+    for checker in _MODULE_CHECKERS:
+        for mod in modules:
+            findings.extend(checker(mod))
+    for checker in _PROJECT_CHECKERS:
+        findings.extend(checker(modules))
+    kept = []
+    for f in findings:
+        if not _rule_selected(f.rule, select, ignore):
+            continue
+        mod = by_path.get(f.path)
+        if mod is not None and mod.suppressed(f.rule, f.line):
+            continue
+        kept.append(f)
+    return sorted(set(kept))
+
+
+def render(findings: list, fmt: str = "text") -> str:
+    if fmt == "json":
+        return json.dumps({"findings": [f.to_json() for f in findings],
+                           "count": len(findings)}, indent=2)
+    lines = [f.render() for f in findings]
+    lines.append(f"dk-check: {len(findings)} finding(s)")
+    return "\n".join(lines)
+
+
+# -- shared AST helpers (used by the rule modules) --------------------------
+
+def call_name(node: ast.AST) -> str:
+    """Dotted name of a call target / attribute chain, '' when dynamic."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def walk_scope(fn: ast.AST):
+    """Yield nodes of a function body without descending into nested defs
+    (class bodies still descend — they execute inline)."""
+    todo = list(ast.iter_child_nodes(fn))
+    while todo:
+        node = todo.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            todo.extend(ast.iter_child_nodes(node))

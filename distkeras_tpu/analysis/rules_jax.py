@@ -1,0 +1,306 @@
+"""DK1xx — JAX purity / retrace lints.
+
+A function is **traced** when its body runs under a jax transform: its
+Python side effects happen once at trace time (silently stale thereafter),
+its host reads are burned into the compiled program as constants, and
+non-hashable static arguments force a retrace per call. These rules mark a
+function traced when it is
+
+* decorated with ``jit``/``pjit``/``pmap``/``vmap``/``grad``/
+  ``value_and_grad``/``shard_map``/``pallas_call`` (bare, dotted, or via
+  ``partial(jax.jit, ...)``), or
+* a local ``def``/``lambda`` passed to one of those wrappers, or to a
+  ``lax.``-qualified control-flow combinator (``scan``, ``cond``,
+  ``while_loop``, ``fori_loop``, ``switch``, ``map``, ``associated_scan``).
+
+Known limit (documented in docs/ANALYSIS.md): traced-ness does not
+propagate through ordinary calls — a helper called *from* a traced body is
+only checked if it is itself wrapped. The runtime lock-order witness and
+the engines' own tests cover the dynamic side.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from distkeras_tpu.analysis.core import (
+    Finding, Module, RuleInfo, call_name, module_rule)
+
+_WRAPPERS = frozenset({
+    "jit", "pjit", "pmap", "vmap", "grad", "value_and_grad", "shard_map",
+    "pallas_call",
+})
+_LAX_COMBINATORS = frozenset({
+    "scan", "cond", "while_loop", "fori_loop", "switch", "map",
+    "associative_scan",
+})
+#: DK101 — host reads whose value is frozen at trace time.
+_IMPURE_READS = frozenset({
+    "os.environ.get", "os.getenv", "time.time", "time.time_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.monotonic",
+    "time.monotonic_ns", "datetime.now", "datetime.utcnow", "uuid.uuid4",
+})
+_CONFIG_ACCESSORS = frozenset({"env_bool", "env_int", "env_float", "env_str"})
+#: DK102 — host I/O / side effects that silently run only at trace time.
+_IO_CALLS = frozenset({"open", "print", "input"})
+_IO_PREFIXES = ("subprocess.", "shutil.", "logging.")
+_IO_OS_CALLS = frozenset({
+    "os.remove", "os.unlink", "os.makedirs", "os.mkdir", "os.listdir",
+    "os.rename", "os.stat", "os.kill", "os.system",
+})
+# Names that read as container mutation. `update`/`pop`/`setdefault` are
+# deliberately absent: optax's pure `tx.update(...)` and pytree `.pop` idioms
+# collide with the dict methods and would drown DK105 in false positives.
+_MUTATORS = frozenset({
+    "append", "extend", "insert", "add", "clear", "remove", "discard",
+    "appendleft",
+})
+_TELE_METHODS = frozenset({"counter", "gauge", "histogram", "span", "event"})
+
+
+def _last(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _is_wrapper_ref(node: ast.AST) -> bool:
+    """``jit`` / ``jax.jit`` / ``partial(jax.jit, ...)`` reference?"""
+    if isinstance(node, ast.Call) and _last(call_name(node.func)) == "partial":
+        return bool(node.args) and _is_wrapper_ref(node.args[0])
+    name = call_name(node)
+    return bool(name) and _last(name) in _WRAPPERS
+
+
+def _is_lax_combinator(call: ast.Call) -> bool:
+    name = call_name(call.func)
+    if "." not in name:
+        return False
+    head, last = name.rsplit(".", 1)
+    return last in _LAX_COMBINATORS and head.split(".")[-1] == "lax"
+
+
+def _collect_traced(mod: Module) -> list:
+    """(node, reason) for every function object whose body is traced."""
+    defs: dict = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    traced: dict = {}
+
+    def mark(fn_node, reason: str) -> None:
+        traced.setdefault(id(fn_node), (fn_node, reason))
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_wrapper_ref(dec) or (
+                        isinstance(dec, ast.Call) and _is_wrapper_ref(dec.func)):
+                    mark(node, f"decorated with {ast.unparse(dec)}")
+        elif isinstance(node, ast.Call):
+            if _is_wrapper_ref(node.func):
+                wrapper = _last(call_name(node.func)) or "partial"
+                cands = node.args[:1]
+            elif _is_lax_combinator(node):
+                wrapper = call_name(node.func)
+                cands = list(node.args) + [kw.value for kw in node.keywords]
+            else:
+                continue
+            for arg in cands:
+                if isinstance(arg, ast.Lambda):
+                    mark(arg, f"passed to {wrapper}")
+                elif isinstance(arg, ast.Name) and arg.id in defs:
+                    mark(defs[arg.id], f"passed to {wrapper}")
+    return list(traced.values())
+
+
+def _locals_of(fn) -> set:
+    """Every name bound anywhere inside ``fn`` (params, assignments, loop
+    targets, nested defs) — mutation of these is internal to the trace."""
+    names: set = set()
+
+    def add_target(t) -> None:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                names.add(n.id)
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            a = node.args
+            for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                        + ([a.vararg] if a.vararg else [])
+                        + ([a.kwarg] if a.kwarg else [])):
+                names.add(arg.arg)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                add_target(t)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.NamedExpr)):
+            add_target(node.target)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            add_target(node.target)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    add_target(item.optional_vars)
+        elif isinstance(node, ast.comprehension):
+            add_target(node.target)
+    return names
+
+
+def _tele_handles(mod: Module) -> set:
+    """Names bound from ``telemetry.get()`` anywhere in the module."""
+    handles = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            name = call_name(node.value.func)
+            if _last(name) == "get" and "telemetry" in name.split("."):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        handles.add(t.id)
+    return handles
+
+
+@module_rule(
+    RuleInfo("DK101", "impure host read (env/time/random) inside traced code"),
+    RuleInfo("DK102", "host I/O or side effect inside traced code"),
+    RuleInfo("DK103", "telemetry call inside traced code"),
+    RuleInfo("DK104", "non-hashable static argument on a jitted function"),
+    RuleInfo("DK105", "traced code mutates enclosing/global state"),
+)
+def check_jax(mod: Module) -> list:
+    out: list = []
+    traced = _collect_traced(mod)
+    handles = _tele_handles(mod)
+    fname = lambda fn: getattr(fn, "name", "<lambda>")  # noqa: E731
+
+    for fn, reason in traced:
+        local_names = _locals_of(fn)
+        for node in ast.walk(fn):
+            line, col = getattr(node, "lineno", fn.lineno), getattr(
+                node, "col_offset", 0)
+            if isinstance(node, ast.Call):
+                name = call_name(node.func)
+                last = _last(name)
+                root = name.split(".")[0]
+                if (name in _IMPURE_READS or last in _CONFIG_ACCESSORS
+                        or (root in ("random", "np", "numpy")
+                            and "random" in name.split(".")[:-1])
+                        or (root == "random" and "." in name)):
+                    out.append(Finding(
+                        mod.path, line, col, "DK101",
+                        f"`{name}()` inside traced `{fname(fn)}` ({reason}): "
+                        "the value is frozen at trace time — pass it in as "
+                        "an argument or read it before tracing"))
+                elif (name in _IO_CALLS or name in _IO_OS_CALLS
+                      or name == "time.sleep"
+                      or name.startswith(_IO_PREFIXES)
+                      or (root == "warnings" and last == "warn")):
+                    out.append(Finding(
+                        mod.path, line, col, "DK102",
+                        f"host I/O `{name}()` inside traced `{fname(fn)}` "
+                        f"({reason}): runs once at trace time, never per "
+                        "step — use jax.debug.print/callback or hoist it"))
+                elif (root == "telemetry" and "." in name) or (
+                        root in handles and last in _TELE_METHODS):
+                    out.append(Finding(
+                        mod.path, line, col, "DK103",
+                        f"telemetry call `{name}()` inside traced "
+                        f"`{fname(fn)}` ({reason}): records trace-time, not "
+                        "run-time — instrument the host loop instead"))
+                elif last in _MUTATORS:
+                    recv = call_name(node.func)
+                    recv_root = recv.split(".")[0]
+                    if (recv_root and recv_root not in local_names
+                            and recv_root != "self"
+                            and recv.count(".") == 1):
+                        out.append(Finding(
+                            mod.path, line, col, "DK105",
+                            f"`{recv}()` inside traced `{fname(fn)}` mutates "
+                            f"closed-over `{recv_root}`: happens at trace "
+                            "time only — return the value instead"))
+            elif isinstance(node, ast.Subscript):
+                if call_name(node.value) == "os.environ":
+                    out.append(Finding(
+                        mod.path, line, col, "DK101",
+                        f"`os.environ[...]` inside traced `{fname(fn)}` "
+                        f"({reason}): frozen at trace time"))
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                out.append(Finding(
+                    mod.path, line, col, "DK105",
+                    f"`{'global' if isinstance(node, ast.Global) else 'nonlocal'}"
+                    f" {', '.join(node.names)}` inside traced `{fname(fn)}` "
+                    f"({reason}): rebinding happens at trace time only"))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        out.append(Finding(
+                            mod.path, line, col, "DK105",
+                            f"write to `self.{t.attr}` inside traced "
+                            f"`{fname(fn)}` ({reason}): object state mutates "
+                            "at trace time only — thread it through the "
+                            "carry instead"))
+    out.extend(_check_static_args(mod))
+    return out
+
+
+def _mutable_default(node) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    return (isinstance(node, ast.Call)
+            and call_name(node.func) in ("list", "dict", "set", "bytearray"))
+
+
+def _check_static_args(mod: Module) -> list:
+    """DK104: ``static_argnums``/``static_argnames`` naming a parameter whose
+    default is a mutable (unhashable) literal — every call retraces (or
+    raises) instead of hitting the jit cache."""
+    out: list = []
+    defs: dict = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+
+    def static_kw(call: ast.Call):
+        for kw in call.keywords:
+            if kw.arg in ("static_argnums", "static_argnames"):
+                yield kw
+
+    def check_pair(fn_def, kw, site) -> None:
+        params = fn_def.args.args
+        defaults = fn_def.args.defaults
+        off = len(params) - len(defaults)
+        by_index = {off + i: d for i, d in enumerate(defaults)}
+        by_name = {params[off + i].arg: d for i, d in enumerate(defaults)}
+        vals = (kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value])
+        for v in vals:
+            if not isinstance(v, ast.Constant):
+                continue
+            default = (by_index.get(v.value) if isinstance(v.value, int)
+                       else by_name.get(v.value))
+            if default is not None and _mutable_default(default):
+                out.append(Finding(
+                    mod.path, site.lineno, site.col_offset, "DK104",
+                    f"static arg {v.value!r} of `{fn_def.name}` defaults to "
+                    "a mutable (unhashable) value: jit static args must be "
+                    "hashable or every call retraces"))
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and _last(
+                call_name(node.func)) in ("jit", "pjit"):
+            for kw in static_kw(node):
+                if node.args and isinstance(node.args[0], ast.Name) \
+                        and node.args[0].id in defs:
+                    check_pair(defs[node.args[0].id], kw, node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _is_wrapper_ref(dec):
+                    for kw in static_kw(dec):
+                        check_pair(node, kw, dec)
+    return out

@@ -137,8 +137,9 @@ class Checkpointer:
                         os.remove(path)
                     except OSError:
                         pass
-        if (jax.process_count() == 1
-                and os.environ.get("DKTPU_CKPT_DIGEST", "") != "0"):
+        from distkeras_tpu.runtime import config
+
+        if jax.process_count() == 1 and config.env_bool("DKTPU_CKPT_DIGEST"):
             # Integrity sidecar: a content hash of the exact tree handed to
             # orbax. Restore re-hashes and compares (``verify=True``), so a
             # bit-flipped payload that orbax would restore to silent garbage

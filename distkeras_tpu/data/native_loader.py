@@ -16,6 +16,8 @@ import threading
 
 import numpy as np
 
+from distkeras_tpu.runtime import config
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                            "native")
 _SRC = os.path.join(_NATIVE_DIR, "loader.cc")
@@ -23,7 +25,7 @@ _SO = os.path.join(_NATIVE_DIR, "_loader.so")
 
 _lib = None
 _lock = threading.Lock()
-_DISABLED = os.environ.get("DKTPU_NO_NATIVE", "") == "1"
+_DISABLED = config.env_bool("DKTPU_NO_NATIVE")
 
 # Must match dk_abi_version() in native/loader.cc. Bump both on any signature
 # change; a mismatch (stale cached .so, or .cc edited without this constant)

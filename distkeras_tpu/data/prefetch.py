@@ -8,21 +8,12 @@ async, so the main loop's only synchronous cost becomes a queue pop.
 
 from __future__ import annotations
 
-import os
 import queue
 import threading
 import time
 from typing import Callable, Iterator, Optional
 
-
-def _env_float(name: str, default: float) -> float:
-    v = os.environ.get(name, "").strip()
-    return float(v) if v else default
-
-
-def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name, "").strip()
-    return int(v) if v else default
+from distkeras_tpu.runtime import config
 
 
 class RoundFeeder:
@@ -69,11 +60,11 @@ class RoundFeeder:
         self.stage = stage
         self.start_round = start_round
         self.depth = max(1, depth)
-        self.stall_timeout = (_env_float("DKTPU_FEEDER_TIMEOUT", 300.0)
+        self.stall_timeout = (config.env_float("DKTPU_FEEDER_TIMEOUT")
                               if stall_timeout is None else float(stall_timeout))
-        self.stall_warn = (_env_float("DKTPU_FEEDER_WARN", 1.0)
+        self.stall_warn = (config.env_float("DKTPU_FEEDER_WARN")
                            if stall_warn is None else float(stall_warn))
-        self.stage_retries = (_env_int("DKTPU_FEEDER_RETRIES", 0)
+        self.stage_retries = (config.env_int("DKTPU_FEEDER_RETRIES")
                               if stage_retries is None else int(stage_retries))
         self.retry_backoff_s = float(retry_backoff_s)
         self._q: queue.Queue = queue.Queue(maxsize=self.depth)

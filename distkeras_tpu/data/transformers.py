@@ -47,7 +47,8 @@ class OneHotTransformer(Transformer):
     Parity: reference ``OneHotTransformer(output_dim, input_col, output_col)``.
     """
 
-    def __init__(self, output_dim: int, input_col: str = "label", output_col: str = "label_one_hot"):
+    def __init__(self, output_dim: int, input_col: str = "label",
+                 output_col: str = "label_one_hot"):
         self.output_dim = output_dim
         self.input_col = input_col
         self.output_col = output_col

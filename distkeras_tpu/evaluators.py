@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import numpy as np
 
-from distkeras_tpu.data.dataframe import DataFrame
 
 
 def _to_class_indices(col: np.ndarray) -> np.ndarray:

@@ -151,7 +151,8 @@ class MetricsLogger:
     #: of the same dispatch burst (blocked/auto execution delivers one
     #: callback burst per compiled block). Shared constant: the live
     #: straggler monitor and the offline report segment by the same value.
-    from distkeras_tpu.telemetry.core import BURST_EPS_S as _BURST_EPS_S
+    from distkeras_tpu.telemetry.core import (  # noqa: F401 - class-attr re-export
+        BURST_EPS_S as _BURST_EPS_S)
 
     def mean_throughput(self, skip: int = 1) -> float:
         """Aggregate samples/sec, skipping the first ``skip`` timing

@@ -11,7 +11,7 @@ re-deserialization.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -180,7 +180,8 @@ class Model:
         return "\n".join(lines)
 
 
-def uniform_weights(model: Model, bounds: tuple[float, float] = (-0.5, 0.5), seed: int = 0) -> Model:
+def uniform_weights(model: Model, bounds: tuple[float, float] = (-0.5, 0.5),
+                    seed: int = 0) -> Model:
     """Re-init every weight uniformly in ``bounds``.
 
     Parity: ``distkeras/utils.py -> uniform_weights(model, constraints)``.
@@ -189,7 +190,8 @@ def uniform_weights(model: Model, bounds: tuple[float, float] = (-0.5, 0.5), see
     leaves, treedef = jax.tree.flatten(model.params)
     keys = jax.random.split(jax.random.key(seed), len(leaves))
     new = [
-        jax.random.uniform(k, x.shape, x.dtype, lo, hi) if jnp.issubdtype(x.dtype, jnp.floating) else x
+        (jax.random.uniform(k, x.shape, x.dtype, lo, hi)
+         if jnp.issubdtype(x.dtype, jnp.floating) else x)
         for k, x in zip(keys, leaves)
     ]
     return model.with_params(jax.tree.unflatten(treedef, new))

@@ -29,21 +29,22 @@ BatchNorm story (two modes):
 
 from __future__ import annotations
 
-import os
 from typing import Any
 
 import jax
 import numpy as np
 
+from distkeras_tpu.runtime import config
+
 # Must win over ~/.keras/keras.json before anything imports keras.
-os.environ.setdefault("KERAS_BACKEND", "jax")
+config.env_setdefault("KERAS_BACKEND", "jax")
 
 from distkeras_tpu.models.base import Model
 from distkeras_tpu.runtime.serialization import register_model_class
 
 
 def _keras():
-    os.environ.setdefault("KERAS_BACKEND", "jax")
+    config.env_setdefault("KERAS_BACKEND", "jax")
     import keras
 
     if keras.backend.backend() != "jax":

@@ -6,7 +6,8 @@ static capacity — ``num_selected=1`` is Switch (gate = the winning prob),
 pair; primary selections fill expert queues before secondaries so an
 overflowing expert drops second choices first). Dispatch/combine are one-hot
 einsums (fully differentiable, static shapes — XLA-friendly), expert FFNs are
-a ``nn.vmap``-stacked bank whose leading axis carries the expert id. Expert parallelism is GSPMD-style: shard the
+a ``nn.vmap``-stacked bank whose leading axis carries the expert id. Expert
+parallelism is GSPMD-style: shard the
 stacked expert params over the ``expert`` mesh axis (``parallel/sharding.py ->
 MOE_RULES``) and XLA lowers the dispatch/combine einsums into the all-to-alls —
 no hand-written routing collectives to get wrong.

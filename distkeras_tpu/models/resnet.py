@@ -18,7 +18,6 @@ need their ResNet param paths remapped on restore —
 
 from __future__ import annotations
 
-from typing import Any
 
 import jax
 from flax import linen as nn

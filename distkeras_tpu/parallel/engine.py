@@ -22,7 +22,6 @@ reference pushed through pickle/TCP per commit, now on ICI.
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, Callable, NamedTuple, Optional
 
 import jax

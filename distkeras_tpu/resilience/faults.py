@@ -49,6 +49,8 @@ import os
 import threading
 from typing import Optional
 
+from distkeras_tpu.runtime import config
+
 #: fault kinds and whether they take an argument.
 _KINDS = frozenset({
     "nan", "inf", "stall", "feeder_error", "crash", "kill", "ckpt_corrupt",
@@ -107,11 +109,12 @@ class FaultPlan:
 
     @classmethod
     def from_env(cls) -> Optional["FaultPlan"]:
-        spec = os.environ.get("DKTPU_FAULTS", "")
-        if not spec.strip():
+        spec = config.env_str("DKTPU_FAULTS")
+        if not spec:
             return None
-        return cls.parse(spec, state_file=os.environ.get(
-            "DKTPU_FAULTS_STATE") or None)
+        return cls.parse(spec,
+                         state_file=config.env_str("DKTPU_FAULTS_STATE")
+                         or None)
 
     # ------------------------------------------------------------------
     def _fire(self, kind: str, at: int) -> Optional[float]:
@@ -194,13 +197,13 @@ def active_plan() -> Optional[FaultPlan]:
     global _CACHED_SPEC, _CACHED_PLAN
     if _EXPLICIT_SET:
         return _EXPLICIT
-    spec = os.environ.get("DKTPU_FAULTS", "").strip()
+    spec = config.env_str("DKTPU_FAULTS")
     if not spec:
         return None
     with _LOCK:
         if spec != _CACHED_SPEC:
-            _CACHED_PLAN = FaultPlan.parse(spec, state_file=os.environ.get(
-                "DKTPU_FAULTS_STATE") or None)
+            _CACHED_PLAN = FaultPlan.parse(
+                spec, state_file=config.env_str("DKTPU_FAULTS_STATE") or None)
             _CACHED_SPEC = spec
         return _CACHED_PLAN
 

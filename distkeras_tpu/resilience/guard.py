@@ -28,18 +28,12 @@ import numpy as np
 
 from distkeras_tpu.resilience import faults
 from distkeras_tpu.resilience.errors import InjectedFault
+from distkeras_tpu.runtime import config
 
 
 def nan_guard_enabled() -> bool:
     """Default for the engines' on-device NaN/Inf round skip."""
-    return os.environ.get("DKTPU_NAN_GUARD", "") != "0"
-
-
-def _env_float(name: str) -> Optional[float]:
-    v = os.environ.get(name, "").strip()
-    if not v:
-        return None
-    return float(v)
+    return config.env_bool("DKTPU_NAN_GUARD")
 
 
 class RoundGuard:
@@ -54,7 +48,7 @@ class RoundGuard:
         self.plan = faults.active_plan()
         thr = getattr(engine, "divergence_reset", None)
         if thr is None:
-            thr = _env_float("DKTPU_DIVERGENCE_RESET")
+            thr = config.env_float("DKTPU_DIVERGENCE_RESET")
         disc = getattr(engine, "discipline", None)
         self.divergence_reset: Optional[float] = (
             float(thr)

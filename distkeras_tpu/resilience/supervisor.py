@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import time
 import warnings
-from typing import Optional, Tuple, Type
+from typing import Tuple, Type
 
 
 class Supervisor:
@@ -88,10 +88,12 @@ class Supervisor:
                     telemetry.counter("resilience.supervisor_retries").add(1)
                     telemetry.event("supervisor_retry", {
                         "attempt": self.attempts, "error": repr(e)})
+                    how = ("resuming from checkpoint"
+                           if self.trainer.checkpoint_dir
+                           else "restarting from scratch")
                     warnings.warn(
                         f"supervised train attempt {self.attempts} failed "
-                        f"({type(e).__name__}: {e}); "
-                        f"{'resuming from checkpoint' if self.trainer.checkpoint_dir else 'restarting from scratch'} "
+                        f"({type(e).__name__}: {e}); {how} "
                         f"({self.max_retries - retries} retries left)",
                         stacklevel=2)
                     if self.trainer.checkpoint_dir:

@@ -1,21 +1,34 @@
-"""Run-level configuration.
+"""Run-level configuration and the typed environment-variable registry.
 
-The reference keeps every hyperparameter as a trainer ``__init__`` kwarg
-(``distkeras/trainers.py``: ``num_workers``, ``batch_size``, ``num_epoch``,
-``communication_window``, ``learning_rate``, ``master_port``...). The trainers keep
-that kwargs-first surface and normalize into this frozen dataclass
-(``Trainer.config``); the kwarg names remain live as properties delegating here.
+Two surfaces live here:
+
+* :class:`RunConfig` — the reference keeps every hyperparameter as a trainer
+  ``__init__`` kwarg (``distkeras/trainers.py``: ``num_workers``,
+  ``batch_size``, ``num_epoch``, ``communication_window``, ``learning_rate``,
+  ``master_port``...). The trainers keep that kwargs-first surface and
+  normalize into this frozen dataclass (``Trainer.config``); the kwarg names
+  remain live as properties delegating here.
+
+* The ``DKTPU_*`` **environment registry** — the single home for every
+  environment variable the framework reads. Each variable is declared once
+  as an :class:`EnvVar` (name, type, default, doc, category) and read
+  through the typed ``env_*`` accessors below. This is the only module
+  allowed to touch ``os.environ``; the dk-check rule DK301
+  (``distkeras_tpu/analysis``) enforces that, DK302 rejects undeclared
+  ``DKTPU_*`` names anywhere in the package, and DK303 keeps the
+  auto-generated docs tables (``python -m distkeras_tpu.analysis
+  --write-env-docs``) in sync with this registry.
+
+This module must stay importable without jax (the analyzer and the
+telemetry core import it; telemetry is contractually jax-free), so the
+dtype table resolves lazily.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional
-
-import jax.numpy as jnp
-
-_DTYPES = {None: None, "float32": jnp.float32, "bfloat16": jnp.bfloat16,
-           "float16": jnp.float16}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -32,7 +45,216 @@ class RunConfig:
 
     @property
     def dtype(self):
-        return _DTYPES[self.compute_dtype]
+        import jax.numpy as jnp  # lazy: keep this module importable sans jax
+
+        return {None: None, "float32": jnp.float32,
+                "bfloat16": jnp.bfloat16, "float16": jnp.float16}[
+                    self.compute_dtype]
 
     def replace(self, **kw) -> "RunConfig":
         return dataclasses.replace(self, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Environment-variable registry
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    """One declared environment variable: the registry row.
+
+    ``kind`` is the accessor family (``bool``/``int``/``float``/``str``);
+    ``default`` is what an unset or empty variable reads as (``None`` means
+    "no value configured"). ``doc`` is one rendered sentence — it IS the
+    docs-table cell, keep it self-contained.
+    """
+
+    name: str
+    kind: str
+    default: object
+    doc: str
+    category: str  # "observability" | "resilience" | "data" | "interop"
+
+
+def _declare(*vars_: EnvVar) -> dict:
+    reg: dict = {}
+    for v in vars_:
+        if v.name in reg:
+            raise ValueError(f"duplicate EnvVar {v.name!r}")
+        reg[v.name] = v
+    return reg
+
+
+ENV_REGISTRY: dict = _declare(
+    EnvVar("DKTPU_TELEMETRY", "bool", True,
+           "Master switch for the telemetry registry; `0` swaps every "
+           "span/counter/gauge/histogram for a no-op singleton.",
+           "observability"),
+    EnvVar("DKTPU_NAN_GUARD", "bool", True,
+           "On-device NaN/Inf round skip in the engine round bodies; `0` "
+           "disables (poisoned rounds then propagate into the center).",
+           "resilience"),
+    EnvVar("DKTPU_CKPT_DIGEST", "bool", True,
+           "sha256 integrity sidecars next to each checkpoint step; `0` "
+           "disables writing (and therefore verified restore).",
+           "resilience"),
+    EnvVar("DKTPU_DIVERGENCE_RESET", "float", None,
+           "Opt-in divergent-worker reset threshold: a worker whose loss "
+           "strays more than this from the finite worker mean re-adopts the "
+           "center. Unset = off (the default path never fetches the loss).",
+           "resilience"),
+    EnvVar("DKTPU_FEEDER_WARN", "float", 1.0,
+           "Seconds of input-pipeline silence before the first stall "
+           "warning; later warnings back off exponentially (2x, 4x, ...).",
+           "resilience"),
+    EnvVar("DKTPU_FEEDER_TIMEOUT", "float", 300.0,
+           "Seconds of input-pipeline silence after which the RoundFeeder "
+           "declares the data plane dead with `FeederStalledError`.",
+           "resilience"),
+    EnvVar("DKTPU_FEEDER_RETRIES", "int", 0,
+           "Retries (exponential backoff) for a *failed* feeder stage call "
+           "before the error propagates; 0 = off.",
+           "resilience"),
+    EnvVar("DKTPU_FAULTS", "str", "",
+           "Fault-injection plan, `kind@round[:arg]` entries separated by "
+           "`;` (e.g. `nan@3;stall@5:0.5;crash@7;seed=11`). Empty = no "
+           "injection. See docs/RESILIENCE.md for the fault taxonomy.",
+           "resilience"),
+    EnvVar("DKTPU_FAULTS_STATE", "str", "",
+           "Path to the fired-faults journal so one-shot faults (notably "
+           "`kill@R`) survive the process restart they cause. Empty = "
+           "in-memory only.",
+           "resilience"),
+    EnvVar("DKTPU_NO_NATIVE", "bool", False,
+           "`1` disables the native (C++) data-plane kernels; every gather "
+           "falls back to numpy (bit-identical, slower).",
+           "data"),
+    # Interop variables (not DKTPU_-prefixed): written, never branched on.
+    EnvVar("KERAS_BACKEND", "str", "",
+           "Set (never read for branching) to `jax` before any keras import "
+           "so the Keras-3 adapter runs on the JAX backend.",
+           "interop"),
+    EnvVar("KERAS_HOME", "str", "",
+           "Written by `utils.set_keras_base_directory` (reference-parity "
+           "shim) to point Keras-3's home at `<path>/.keras`.",
+           "interop"),
+)
+
+_FALSE_STRINGS = frozenset({"0", "false", "no", "off"})
+
+
+def _entry(name: str, kind: str) -> EnvVar:
+    var = _registered(name)
+    if var.kind != kind:
+        raise TypeError(
+            f"{name} is registered as kind={var.kind!r}; read it with "
+            f"env_{var.kind}()")
+    return var
+
+
+def _raw(name: str) -> str:
+    return os.environ.get(name, "").strip()
+
+
+def env_bool(name: str) -> bool:
+    """Registered boolean: unset/empty reads the declared default; any other
+    value is truthy unless it is one of ``0/false/no/off``."""
+    var = _entry(name, "bool")
+    raw = _raw(name)
+    if not raw:
+        return bool(var.default)
+    return raw.lower() not in _FALSE_STRINGS
+
+
+def env_int(name: str) -> int:
+    var = _entry(name, "int")
+    raw = _raw(name)
+    return int(raw) if raw else int(var.default)
+
+
+def env_float(name: str) -> Optional[float]:
+    """Registered float; a ``None`` default means "unset reads as None"
+    (used for opt-in thresholds like ``DKTPU_DIVERGENCE_RESET``)."""
+    var = _entry(name, "float")
+    raw = _raw(name)
+    if raw:
+        return float(raw)
+    return None if var.default is None else float(var.default)
+
+
+def env_str(name: str) -> str:
+    var = _entry(name, "str")
+    return os.environ.get(name, "").strip() or str(var.default)
+
+
+def _registered(name: str) -> EnvVar:
+    """Registry row for ``name`` regardless of kind (write accessors)."""
+    var = ENV_REGISTRY.get(name)
+    if var is None:
+        raise KeyError(
+            f"{name!r} is not a registered environment variable; declare it "
+            "in distkeras_tpu.runtime.config.ENV_REGISTRY (dk-check DK302)")
+    return var
+
+
+def env_set(name: str, value: str) -> None:
+    """Write a registered variable (interop shims only)."""
+    _registered(name)
+    os.environ[name] = value
+
+
+def env_setdefault(name: str, value: str) -> str:
+    _registered(name)
+    return os.environ.setdefault(name, value)
+
+
+# -- docs generation --------------------------------------------------------
+
+def iter_env_vars(category: Optional[str] = None):
+    for var in ENV_REGISTRY.values():
+        if category is None or var.category == category:
+            yield var
+
+
+def render_env_table(category: Optional[str] = None) -> str:
+    """The markdown env-var table for ``category`` (None = all, with a
+    category column). Injected between ``<!-- dk-env:begin ... -->`` /
+    ``<!-- dk-env:end -->`` markers by ``--write-env-docs``; DK303 fails CI
+    when a docs table no longer matches this rendering."""
+    rows = list(iter_env_vars(category))
+    with_cat = category is None
+    head = "| Variable | Type | Default | Description |"
+    sep = "|---|---|---|---|"
+    if with_cat:
+        head = "| Variable | Type | Default | Category | Description |"
+        sep = "|---|---|---|---|---|"
+    out = [head, sep]
+    for v in rows:
+        default = "unset" if v.default in (None, "") else f"`{v.default}`"
+        cells = [f"`{v.name}`", v.kind, default]
+        if with_cat:
+            cells.append(v.category)
+        cells.append(v.doc)
+        out.append("| " + " | ".join(cells) + " |")
+    return "\n".join(out)
+
+
+def splice_env_docs(text: str, path_hint: str = "") -> str:
+    """Replace every ``<!-- dk-env:begin [category=X] -->`` ...
+    ``<!-- dk-env:end -->`` block in ``text`` with the freshly rendered
+    table for that category."""
+    import re
+
+    def sub(m) -> str:
+        category = m.group("cat") or None
+        return (m.group("open") + "\n" + render_env_table(category)
+                + "\n" + m.group("close"))
+
+    pat = re.compile(
+        r"(?P<open><!-- dk-env:begin(?: category=(?P<cat>[\w-]+))? -->)"
+        r".*?(?P<close><!-- dk-env:end -->)",
+        re.DOTALL)
+    out, n = pat.subn(sub, text)
+    if n == 0 and path_hint:
+        raise ValueError(f"no dk-env marker block found in {path_hint}")
+    return out

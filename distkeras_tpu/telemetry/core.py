@@ -19,7 +19,6 @@ Design constraints, in order:
 from __future__ import annotations
 
 import bisect
-import os
 import threading
 import time
 from typing import Optional
@@ -390,7 +389,9 @@ _GLOBAL_LOCK = threading.Lock()
 
 
 def enabled() -> bool:
-    return os.environ.get("DKTPU_TELEMETRY", "") != "0"
+    from distkeras_tpu.runtime import config  # jax-free module: safe here
+
+    return config.env_bool("DKTPU_TELEMETRY")
 
 
 def get() -> Telemetry:

@@ -22,9 +22,11 @@ def set_keras_base_directory(path: str = ".") -> None:
     TPU equivalent is needed — models are pure pytrees, nothing touches a
     Keras home directory — but ported notebooks may still call it, so it
     accepts the call and points Keras-3's home at ``<path>/.keras``."""
-    import os
+    import os.path
 
-    os.environ["KERAS_HOME"] = os.path.join(path, ".keras")
+    from distkeras_tpu.runtime import config
+
+    config.env_set("KERAS_HOME", os.path.join(path, ".keras"))
 
 
 def serialize_keras_model(model: Model) -> bytes:

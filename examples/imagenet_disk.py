@@ -231,8 +231,9 @@ def main():
     du = sum(os.stat(os.path.join(root, f)).st_blocks * 512
              for f in os.listdir(root))
     sdf = dk.ShardedDataFrame(root)
-    print(f"logical rows: {sdf.count():,} "
-          f"({sdf.count() * args.image_hw**2 * 3 * np.dtype(args.dtype).itemsize / 1e9:.1f} GB logical); "
+    logical_gb = (sdf.count() * args.image_hw ** 2 * 3
+                  * np.dtype(args.dtype).itemsize / 1e9)
+    print(f"logical rows: {sdf.count():,} ({logical_gb:.1f} GB logical); "
           f"actual disk use: {du / 1e6:.1f} MB")
 
     if args.measure_feed:

@@ -1,0 +1,111 @@
+"""Planted DK2xx violations for tests/test_analysis.py.
+
+``# PLANT:`` markers pin line-exact findings; DK201's finding line depends
+on graph traversal order, so it uses the file-level ``# PLANT-FILE:``
+marker (exact count, any line). This module is also *executed* by
+``test_static_graph_matches_witnessed_order`` — importing it only defines
+locks/classes; the planted thread leaks live in functions no test calls.
+"""
+# PLANT-FILE: DK201=2
+
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+
+def forward():
+    with _lock_a:
+        with _lock_b:
+            pass
+
+
+def backward():  # inverted order vs forward(): the DK201 cycle
+    with _lock_b:
+        with _lock_a:
+            pass
+
+
+class Pool:
+    """Second DK201: the inversion is only visible through a call edge."""
+
+    def __init__(self):
+        self._alloc = threading.Lock()
+        self._free = threading.Lock()
+
+    def take(self):
+        with self._alloc:
+            self._refill()  # acquires _free while holding _alloc
+
+    def _refill(self):
+        with self._free:
+            pass
+
+    def drain(self):
+        with self._free:
+            with self._alloc:
+                pass
+
+
+class Buffer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+        self.total = 0
+
+    def put(self, x):
+        with self._lock:
+            self.items.append(x)
+            self.total += 1
+
+    def fast_put(self, x):  # races put(): same attrs, no lock
+        self.items.append(x)  # PLANT: DK202
+        self.total += 1  # PLANT: DK202
+
+
+def spawn(target):
+    worker = threading.Thread(target=target)  # PLANT: DK203
+    worker.start()
+
+
+class Owner:
+    def start(self, fn):
+        self._t = threading.Thread(target=fn)  # PLANT: DK203
+        self._t.start()
+
+
+def swallowing_loop(q):
+    while True:
+        try:
+            q.get()
+        except:  # PLANT: DK204
+            pass
+
+
+def swallowing_drain(q):
+    for _ in range(10):
+        try:
+            q.get()
+        except BaseException:  # PLANT: DK204
+            continue
+
+
+def reraising(q):  # negative control: re-raise is not swallowing
+    try:
+        q.get()
+    except BaseException:
+        raise
+
+
+def surfacing(q, errors):  # negative control: the bound exc is surfaced
+    try:
+        q.get()
+    except BaseException as e:
+        errors.append(e)
+
+
+def suppressed(q):
+    try:
+        q.get()
+    except:  # dk: disable=DK204 - fixture: suppression must silence this
+        pass

@@ -1,0 +1,26 @@
+"""Planted DK3xx violations for tests/test_analysis.py (parsed, never run)."""
+
+import os
+
+
+def telemetry_enabled():
+    return os.environ.get("DKTPU_TELEMETRY", "") != "0"  # PLANT: DK301
+
+
+def native_disabled():
+    return os.getenv("DKTPU_NO_NATIVE") == "1"  # PLANT: DK301
+
+
+FEATURE_FLAG = "DKTPU_EXPERIMENTAL_FOO"  # PLANT: DK302
+
+
+def fetch_secret():
+    return os.environ["DKTPU_SECRET_KNOB"]  # PLANT: DK301 DK302
+
+
+def documented_and_registered() -> str:
+    """Negative control: a registered name in a docstring (DKTPU_FAULTS)
+    plus a registry accessor read is exactly the sanctioned pattern."""
+    from distkeras_tpu.runtime import config
+
+    return config.env_str("DKTPU_FAULTS")

@@ -1,0 +1,108 @@
+"""Planted DK1xx violations for tests/test_analysis.py.
+
+Each violating line carries a ``# PLANT: <rules>`` marker; the test asserts
+every rule fires exactly on its marked lines and nowhere else. This module
+is parsed by the analyzer, never imported — names need not resolve.
+"""
+
+import os
+import time
+from functools import partial
+
+import jax
+from jax import lax
+
+from distkeras_tpu import telemetry
+
+
+@jax.jit
+def env_inside_jit(x):
+    flag = os.environ.get("DKTPU_TELEMETRY", "")  # PLANT: DK101 DK301
+    return x if flag else -x
+
+
+def clock_body(carry, _):
+    now = time.perf_counter()  # PLANT: DK101
+    return carry + now, None
+
+
+def run_scan(xs):
+    return lax.scan(clock_body, 0.0, xs)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def prints_while_tracing(x, k):
+    print("tracing", k)  # PLANT: DK102
+    return x * k
+
+
+@jax.jit
+def reads_file(x):
+    with open("/tmp/stats.txt") as f:  # PLANT: DK102
+        f.read()
+    return x
+
+
+@jax.jit
+def telemetry_module_call(x):
+    telemetry.get()  # PLANT: DK103
+    return x
+
+
+tele = telemetry.get()
+
+
+@jax.jit
+def telemetry_handle_call(x):
+    tele.counter("rounds").add(1)  # PLANT: DK103
+    return x
+
+
+def windowed(x, sizes=[4, 8]):
+    return x
+
+
+jitted_windowed = jax.jit(windowed, static_argnums=(1,))  # PLANT: DK104
+
+
+@partial(jax.jit, static_argnames=("mode",))  # PLANT: DK104
+def decorated_static(x, mode={"train": True}):
+    return x
+
+
+_history = []
+
+
+@jax.jit
+def appends_to_module_list(x):
+    _history.append(x)  # PLANT: DK105
+    return x
+
+
+_step = 0
+
+
+@jax.jit
+def rebinds_global(x):
+    global _step  # PLANT: DK105
+    _step = _step + 1
+    return x + _step
+
+
+class Stateful:
+    def make_traced(self):
+        @jax.jit
+        def inner(x):
+            self.cache = x  # PLANT: DK105
+            return x
+        return inner
+
+
+@jax.jit
+def clean_control(x):
+    """Pure traced code: locals mutate freely, no findings."""
+    parts = []
+    parts.append(x)
+    total = {"x": x}
+    total["x"] = x + 1
+    return parts[0] + total["x"]

@@ -1,0 +1,260 @@
+"""dk-check suite: fixture corpus (every rule fires exactly on its planted
+line), real-package cleanliness, suppressions, the env registry, and the
+runtime lock-order witness (incl. static-graph/runtime agreement)."""
+
+import os
+import re
+import threading
+
+import pytest
+
+import distkeras_tpu
+from distkeras_tpu.analysis import core, run, witness
+from distkeras_tpu.analysis.rules_concurrency import build_lock_graph
+from distkeras_tpu.runtime import config
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "analysis")
+PKG_DIR = os.path.dirname(os.path.abspath(distkeras_tpu.__file__))
+_PLANT_RE = re.compile(r"#\s*PLANT:\s*([A-Z0-9 ]+)")
+_PLANT_FILE_RE = re.compile(r"#\s*PLANT-FILE:\s*(DK\d+)=(\d+)")
+
+
+def _expected(path):
+    """(line-pinned {(line, rule)}, file-level {rule: count}) from markers."""
+    pinned, counts = set(), {}
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            m = _PLANT_RE.search(line)
+            if m:
+                for rule in m.group(1).split():
+                    pinned.add((lineno, rule))
+            m = _PLANT_FILE_RE.search(line)
+            if m:
+                counts[m.group(1)] = int(m.group(2))
+    return pinned, counts
+
+
+@pytest.mark.parametrize("fixture", sorted(
+    f for f in os.listdir(FIXTURES) if f.endswith(".py")))
+def test_fixture_rules_fire_exactly_on_planted_lines(fixture):
+    path = os.path.join(FIXTURES, fixture)
+    pinned, counts = _expected(path)
+    assert pinned or counts, f"{fixture} has no PLANT markers"
+    findings = run([path])
+    got_pinned = {(f.line, f.rule) for f in findings
+                  if f.rule not in counts}
+    assert got_pinned == pinned, (
+        f"{fixture}: planted vs fired mismatch\n"
+        f"  missing: {sorted(pinned - got_pinned)}\n"
+        f"  extra:   {sorted(got_pinned - pinned)}")
+    for rule, n in counts.items():
+        fired = [f for f in findings if f.rule == rule]
+        assert len(fired) == n, (
+            f"{fixture}: expected {n}x {rule}, got "
+            f"{[(f.line, f.message) for f in fired]}")
+
+
+def test_real_package_is_clean():
+    """The acceptance gate: dk-check exits 0 on the swept package."""
+    findings = run([PKG_DIR])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_every_rule_family_is_exercised():
+    """The corpus proves each family both fires (fixtures) and stays quiet
+    (package): >=2 planted findings per DK1xx/DK2xx/DK3xx family."""
+    findings = run([FIXTURES])
+    by_family = {}
+    for f in findings:
+        by_family.setdefault(f.rule[:3], []).append(f.rule)
+    for family in ("DK1", "DK2", "DK3"):
+        assert len(by_family.get(family, [])) >= 2, by_family
+
+
+def test_suppression_comment_silences_rule(tmp_path):
+    src = (
+        "def f(q):\n"
+        "    try:\n"
+        "        q.get()\n"
+        "    except:  # dk: disable=DK204 - intentional\n"
+        "        pass\n"
+        "def g(q):\n"
+        "    try:\n"
+        "        q.get()\n"
+        "    except:\n"
+        "        pass\n")
+    p = tmp_path / "supp.py"
+    p.write_text(src)
+    findings = run([str(p)])
+    assert [f.line for f in findings if f.rule == "DK204"] == [9]
+    p.write_text("# dk: disable-file=DK204\n" + src)
+    assert run([str(p)]) == []
+
+
+def test_select_ignore_and_syntax_error(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    findings = run([str(p)])
+    assert [f.rule for f in findings] == ["DK000"]
+    fix = os.path.join(FIXTURES, "config_violations.py")
+    only_302 = run([fix], select=["DK302"])
+    assert only_302 and all(f.rule == "DK302" for f in only_302)
+    no_3xx = run([fix], ignore=["DK3"])
+    assert no_3xx == []
+
+
+def test_cli_roundtrip(tmp_path, capsys):
+    import json
+
+    from distkeras_tpu.analysis.__main__ import main
+
+    fix = os.path.join(FIXTURES, "config_violations.py")
+    assert main([fix, "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["count"] == len(payload["findings"]) > 0
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert main([str(clean)]) == 0
+    assert main([str(tmp_path / "nope.py")]) == 2
+    assert main(["--list-rules"]) == 0
+    listed = capsys.readouterr().out
+    for rule in ("DK101", "DK201", "DK301"):
+        assert rule in listed
+
+
+# -- env registry ----------------------------------------------------------
+
+def test_env_registry_typed_accessors(monkeypatch):
+    monkeypatch.delenv("DKTPU_TELEMETRY", raising=False)
+    assert config.env_bool("DKTPU_TELEMETRY") is True
+    monkeypatch.setenv("DKTPU_TELEMETRY", "0")
+    assert config.env_bool("DKTPU_TELEMETRY") is False
+    monkeypatch.setenv("DKTPU_NO_NATIVE", "1")
+    assert config.env_bool("DKTPU_NO_NATIVE") is True
+    monkeypatch.delenv("DKTPU_FEEDER_TIMEOUT", raising=False)
+    assert config.env_float("DKTPU_FEEDER_TIMEOUT") == 300.0
+    monkeypatch.setenv("DKTPU_FEEDER_TIMEOUT", "2.5")
+    assert config.env_float("DKTPU_FEEDER_TIMEOUT") == 2.5
+    assert config.env_float("DKTPU_DIVERGENCE_RESET") is None
+    assert config.env_int("DKTPU_FEEDER_RETRIES") == 0
+    assert config.env_str("DKTPU_FAULTS") == ""
+    with pytest.raises(KeyError):
+        config.env_bool("DKTPU_NOT_A_THING")
+    with pytest.raises(TypeError):
+        config.env_int("DKTPU_TELEMETRY")  # registered as bool
+
+
+def test_env_docs_render_and_splice():
+    table = config.render_env_table("resilience")
+    assert "`DKTPU_FAULTS`" in table and "DKTPU_TELEMETRY" not in table
+    doc = "x\n<!-- dk-env:begin category=resilience -->\nstale\n<!-- dk-env:end -->\ny"
+    spliced = config.splice_env_docs(doc)
+    assert "stale" not in spliced and "`DKTPU_NAN_GUARD`" in spliced
+    with pytest.raises(ValueError):
+        config.splice_env_docs("no markers here", path_hint="f.md")
+
+
+def test_rule_catalog_documented():
+    core._load_rules()
+    docs = os.path.join(os.path.dirname(PKG_DIR), "docs", "ANALYSIS.md")
+    with open(docs) as f:
+        text = f.read()
+    for rule in core.RULE_CATALOG:
+        assert rule in text, f"{rule} missing from docs/ANALYSIS.md"
+
+
+# -- lock-order witness ----------------------------------------------------
+
+def test_witness_detects_inversion():
+    with witness() as w:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+    assert w.edges()
+    with pytest.raises(AssertionError, match="inversion"):
+        w.assert_no_inversions()
+
+
+def test_witness_clean_order_passes():
+    with witness() as w:
+        a = threading.Lock()
+        b = threading.Lock()
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+    w.assert_no_inversions()
+    assert len(w.edges()) == 1
+
+
+def test_witness_ignores_preexisting_locks():
+    before = threading.Lock()
+    with witness() as w:
+        with before:
+            pass
+    assert w.edges() == set()
+
+
+def test_static_graph_matches_witnessed_order():
+    """The DK201 graph and the runtime witness must agree on the fixture:
+    every dynamically observed edge is in the static graph, and the planted
+    inversion is visible to both."""
+    path = os.path.join(FIXTURES, "concurrency_violations.py")
+    modules, errs = core.parse_modules([path])
+    assert not errs
+    static_edges, _, _ = build_lock_graph(modules)
+    with open(path) as f:
+        src = f.read()
+    ns = {}
+    with witness() as w:
+        exec(compile(src, path, "exec"), ns)  # defines locks under witness
+        ns["forward"]()
+        ns["backward"]()
+        pool = ns["Pool"]()
+        pool.take()
+        pool.drain()
+    observed = {e for e in w.edges()
+                if e[0].startswith("concurrency_violations.")}
+    assert observed, "witness saw no fixture lock nesting"
+    assert observed <= static_edges, observed - static_edges
+    assert w.cycles(), "planted inversion must be dynamically visible"
+
+
+def test_package_lock_graph_is_acyclic_and_witnessed_subset():
+    """No DK201 cycles in the real package, and a live telemetry+feeder
+    burst under the witness observes no inversion and no edge the static
+    graph lacks (for locks it can name)."""
+    modules, _ = core.parse_modules([PKG_DIR])
+    static_edges, _, _ = build_lock_graph(modules)
+    from distkeras_tpu.analysis.rules_concurrency import _find_cycles
+
+    assert _find_cycles(static_edges) == []
+    from distkeras_tpu.telemetry.core import Telemetry
+
+    with witness() as w:
+        tele = Telemetry(enabled=True)
+
+        def worker():
+            for i in range(50):
+                tele.counter("c").add(1)
+                tele.gauge("g").set(i)
+                with tele.span("s"):
+                    tele.histogram("h").observe(0.001)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        tele.snapshot()
+    w.assert_no_inversions()
+    pkg_bases = {os.path.splitext(f)[0] for f in ("core.py",)}
+    observed = {e for e in w.edges()
+                if e[0].split(".")[0] in pkg_bases
+                or e[1].split(".")[0] in pkg_bases}
+    assert observed <= static_edges, observed - static_edges

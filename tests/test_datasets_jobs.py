@@ -3,7 +3,6 @@
 import sys
 
 import numpy as np
-import pytest
 
 from distkeras_tpu.datasets import cifar10, imdb, mnist, synthetic_lm
 from distkeras_tpu.job_deployment import Job, Punchcard

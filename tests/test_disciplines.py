@@ -9,10 +9,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 
 from distkeras_tpu.data import DataFrame, make_batches
-from distkeras_tpu.models import Model, mnist_mlp
+from distkeras_tpu.models import Model
 from distkeras_tpu.models.mlp import MLP
 from distkeras_tpu.ops.losses import get_loss
 from distkeras_tpu.parallel.disciplines import (

@@ -1,6 +1,5 @@
 """Predictors, evaluators, checkpoint/resume, metrics tests."""
 
-import os
 
 import jax
 import jax.numpy as jnp

@@ -62,7 +62,6 @@ def test_native_abi_version_pinned_to_source():
     # were written against. dk_abi_version() pins them: this test fails if
     # loader.cc's version constant and the Python _ABI_VERSION ever diverge
     # (i.e. someone changed a signature on one side only).
-    import ctypes
     import re
 
     from distkeras_tpu.data import native_loader

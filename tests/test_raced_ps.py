@@ -221,3 +221,37 @@ def test_raced_dynsgd_staleness_is_real():
     # regardless of how later rounds interleave into the commit order.
     assert log[0] == 0  # very first commit can never be stale
     assert log.max() >= W - 1, log[: 2 * W]
+
+
+def test_raced_ps_lock_order_witnessed():
+    """The raced PS under the runtime lock-order witness: no inversion is
+    observed across genuinely racing commit threads, and every witnessed
+    edge involving racelab's lock exists in dk-check's static DK201 graph
+    (i.e. the static model is sound for the code the paper's architecture
+    actually races). Numpy-only local step: the witness targets the lock
+    protocol, not the math."""
+    import os
+
+    import distkeras_tpu
+    from distkeras_tpu.analysis import core, witness
+    from distkeras_tpu.analysis.rules_concurrency import build_lock_graph
+
+    rng = np.random.default_rng(0)
+    center = [rng.normal(size=(4, 3)).astype(np.float32)]
+    batches = [[(None, None)] * 6 for _ in range(4)]
+
+    def local_steps(flat, batch):
+        return [a - 0.01 * np.sign(a) for a in flat]
+
+    with witness() as w:
+        final, ps = run_raced(
+            center=center, local_steps=local_steps,
+            worker_batches=batches, window=K, discipline="dynsgd",
+            overlap_first_round=True)
+    w.assert_no_inversions()
+    assert len(ps.commit_log) == 4 * 6
+    pkg = os.path.dirname(os.path.abspath(distkeras_tpu.__file__))
+    modules, _ = core.parse_modules([pkg])
+    static_edges, _, _ = build_lock_graph(modules)
+    raced = {e for e in w.edges() if "racelab" in e[0] or "racelab" in e[1]}
+    assert raced <= static_edges, raced - static_edges

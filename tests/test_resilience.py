@@ -11,7 +11,6 @@ restart, and straggler kill.
 
 import os
 import subprocess
-import sys
 import time
 import warnings
 

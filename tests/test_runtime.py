@@ -12,7 +12,7 @@ from distkeras_tpu import (
     hybrid_mesh,
     serialize_model,
 )
-from distkeras_tpu.models import Model, mnist_mlp, mnist_cnn
+from distkeras_tpu.models import mnist_mlp, mnist_cnn
 from distkeras_tpu.models.base import uniform_weights
 
 

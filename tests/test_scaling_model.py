@@ -9,7 +9,6 @@ one ICI ring direction, zero compute/comm overlap.
 
 import os
 
-import numpy as np
 import pytest
 
 from distkeras_tpu.roofline import FoldScalingModel, allreduce_seconds

@@ -5,7 +5,6 @@ and the acceptance path — a report rendered from JSONLs produced by REAL
 SynchronousDistributedTrainer and ADAG runs."""
 
 import json
-import os
 import time
 
 import jax.numpy as jnp

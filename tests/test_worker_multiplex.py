@@ -13,7 +13,7 @@ import pytest
 
 import jax
 
-from distkeras_tpu import (ADAG, AEASGD, DataFrame, DynSGD, EnsembleTrainer,
+from distkeras_tpu import (ADAG, DataFrame, EnsembleTrainer,
                            SynchronousDistributedTrainer)
 from distkeras_tpu.data.batching import make_batches
 from distkeras_tpu.models import Model
