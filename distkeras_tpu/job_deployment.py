@@ -22,10 +22,21 @@ import subprocess
 import time
 from typing import Optional, Sequence
 
+from distkeras_tpu.resilience.backoff import full_jitter
+
 
 @dataclasses.dataclass
 class Punchcard:
-    """Portable job description (reference ``Punchcard``: the JSON job card)."""
+    """Portable job description (reference ``Punchcard``: the JSON job card).
+
+    ``ps`` opts the job into a networked parameter server
+    (``distkeras_tpu/netps``): ``{"host": ..., "port": ..., "discipline":
+    ..., "lease": ...}`` — ``host`` defaults to the first job host, and
+    only ``ps={}`` is needed for the defaults. :class:`Job` then launches
+    ``python -m distkeras_tpu.netps`` on that host first and hands every
+    worker the endpoint via ``DKTPU_PS_ENDPOINT``, so trainers constructed
+    without an explicit ``remote=`` pick it up automatically.
+    """
 
     job_name: str
     script: str
@@ -33,6 +44,15 @@ class Punchcard:
     coordinator_port: int = 8476
     env: dict = dataclasses.field(default_factory=dict)
     args: Sequence[str] = ()
+    ps: Optional[dict] = None
+
+    def ps_endpoint(self) -> Optional[str]:
+        """``host:port`` of the parameter server, None when ``ps`` unset."""
+        if self.ps is None:
+            return None
+        host = self.ps.get("host") or self.hosts[0]
+        port = int(self.ps.get("port", 7077))
+        return f"{host}:{port}"
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)
@@ -57,19 +77,25 @@ class Job:
         self.ssh_user = ssh_user
         self._procs: list[subprocess.Popen] = []
         self._cmds: list[str] = []
+        #: the parameter-server process (punchcards with ``ps``), launched
+        #: before the workers and torn down with them.
+        self._ps_proc: Optional[subprocess.Popen] = None
         #: restarts performed per host by :meth:`supervise`.
         self.restarts: list[int] = []
 
     def render_commands(self) -> list[str]:
-        """One command line per host, with the jax.distributed bootstrap env."""
+        """One command line per host, with the jax.distributed bootstrap env
+        (plus ``DKTPU_PS_ENDPOINT`` when the punchcard carries a ``ps``)."""
         pc = self.punchcard
         coordinator = f"{pc.hosts[0]}:{pc.coordinator_port}"
+        endpoint = pc.ps_endpoint()
         cmds = []
         for i, _host in enumerate(pc.hosts):
             env = {
                 "JAX_COORDINATOR_ADDRESS": coordinator,
                 "JAX_NUM_PROCESSES": str(len(pc.hosts)),
                 "JAX_PROCESS_ID": str(i),
+                **({"DKTPU_PS_ENDPOINT": endpoint} if endpoint else {}),
                 **pc.env,
             }
             env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
@@ -77,9 +103,25 @@ class Job:
             cmds.append(f"env {env_str} python {shlex.quote(pc.script)} {arg_str}".strip())
         return cmds
 
+    def render_ps_command(self) -> Optional[str]:
+        """The parameter-server launch line (None when ``ps`` is unset)."""
+        pc = self.punchcard
+        if pc.ps is None:
+            return None
+        port = int(pc.ps.get("port", 7077))
+        cmd = (f"python -m distkeras_tpu.netps --host 0.0.0.0 "
+               f"--port {port} "
+               f"--discipline {shlex.quote(pc.ps.get('discipline', 'adag'))}")
+        if pc.ps.get("lease") is not None:
+            cmd += f" --lease {float(pc.ps['lease'])}"
+        return cmd
+
     def _spawn(self, i: int) -> subprocess.Popen:
         """(Re)launch host ``i``'s command."""
-        host, cmd = self.punchcard.hosts[i], self._cmds[i]
+        return self._spawn_cmd(self.punchcard.hosts[i], self._cmds[i])
+
+    def _spawn_cmd(self, host: str, cmd: str) -> subprocess.Popen:
+        """Launch one command line on ``host`` (workers and the PS)."""
         target = f"{self.ssh_user}@{host}" if self.ssh_user else host
         if host in ("localhost", "127.0.0.1"):
             # No shell wrapper: signals from kill()/terminate() must reach
@@ -93,10 +135,19 @@ class Job:
         return subprocess.Popen(["ssh", "-tt", target, cmd])
 
     def launch(self, dry_run: bool = True) -> list[str]:
-        """Start the job on every host; with ``dry_run`` just return the commands."""
+        """Start the job on every host; with ``dry_run`` just return the
+        worker commands (the PS line, if any, is ``render_ps_command()``).
+        A punchcard with ``ps`` launches the parameter server first — the
+        workers' hardened clients retry with backoff, so no readiness
+        handshake is needed before starting them."""
         cmds = self.render_commands()
         if dry_run:
             return cmds
+        ps_cmd = self.render_ps_command()
+        if ps_cmd is not None and self._ps_proc is None:
+            ps_host = (self.punchcard.ps.get("host")
+                       or self.punchcard.hosts[0])
+            self._ps_proc = self._spawn_cmd(ps_host, ps_cmd)
         self._cmds = cmds
         self.restarts = [0] * len(cmds)
         for i in range(len(cmds)):
@@ -121,7 +172,26 @@ class Job:
         except subprocess.TimeoutExpired:
             self.kill()
             raise
+        self._stop_ps()
         return rcs
+
+    def _stop_ps(self, grace: float = 5.0) -> None:
+        """Drain the parameter server once the workers are done: SIGTERM
+        triggers its graceful drain; SIGKILL only if it won't."""
+        p = self._ps_proc
+        if p is None or p.poll() is not None:
+            return
+        try:
+            p.terminate()
+        except OSError:
+            return
+        try:
+            p.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            try:
+                p.kill()
+            except OSError:
+                pass
 
     def poll(self) -> list:
         """Exit codes so far: one entry per host, ``None`` while running."""
@@ -132,8 +202,8 @@ class Job:
                   straggler_timeout: Optional[float] = None) -> list[int]:
         """Babysit the job like a cluster manager. Polls until every process
         exits. A host that exits nonzero is **restarted** (same command, up
-        to ``max_restarts`` times per host, after an exponential
-        ``restart_backoff * 2**n`` delay); once a host exhausts its restart
+        to ``max_restarts`` times per host, after a full-jitter delay drawn
+        from the ``restart_backoff * 2**n`` envelope); once a host exhausts its restart
         budget the survivors get ``grace`` seconds and the job is torn down
         (the original first-failure semantics — the default
         ``max_restarts=0`` behaves exactly as before). With
@@ -155,9 +225,17 @@ class Job:
                 self.kill()
                 return [p.returncode for p in self._procs]
             if not failed and all(rc is not None for rc in rcs):
+                # Clean completion: drain the parameter server too, or it
+                # outlives the job holding its port (kill() covers every
+                # teardown path; this is the one return that skips kill).
+                self._stop_ps()
                 return rcs
             for i in failed:
-                delay = restart_backoff * (2 ** self.restarts[i])
+                # Full jitter (same rule as the netps client's RPC retries):
+                # hosts killed by one sweep must not restart in lockstep —
+                # a synchronized restart storm re-creates the overload that
+                # killed them.
+                delay = full_jitter(restart_backoff, self.restarts[i])
                 self.restarts[i] += 1
                 telemetry.counter("resilience.host_restarts").add(1)
                 telemetry.event("host_restart", {
@@ -193,6 +271,8 @@ class Job:
         unreapable (D-state) process is abandoned rather than hanging the
         caller."""
         live = [p for p in self._procs if p.poll() is None]
+        if self._ps_proc is not None and self._ps_proc.poll() is None:
+            live.append(self._ps_proc)
         for p in live:
             try:
                 p.terminate()

@@ -1,0 +1,56 @@
+"""netps — the networked parameter server, hardened.
+
+The reference's defining artifact (``DeltaParameterServer`` /
+``ADAGParameterServer``: a socket server, one handler thread per worker,
+``with lock: fold(delta)``) rebuilt over a real network boundary with the
+production edges the reference never had:
+
+* :mod:`~distkeras_tpu.netps.wire` — length-prefixed, crc-checksummed
+  binary frames with magic/version/size checks and request-id echo;
+* :mod:`~distkeras_tpu.netps.server` — :class:`PSServer`: one handler
+  thread per connection, idempotent ``(worker_id, seq)`` commits,
+  lease-based elastic membership (eviction + mid-run rejoin), graceful
+  drain;
+* :mod:`~distkeras_tpu.netps.client` — :class:`PSClient`: deadline per
+  RPC (``DKTPU_NET_TIMEOUT``), bounded retries with full-jitter backoff
+  (``DKTPU_NET_RETRIES``/``DKTPU_NET_BACKOFF``), reconnect-on-failure,
+  automatic rejoin after eviction;
+* :mod:`~distkeras_tpu.netps.chaos` — :class:`ChaosProxy`: frame-aware
+  delay/drop/dup/truncate/partition injection per direction, driven by
+  ``DKTPU_NET_FAULTS`` through ``resilience.FaultPlan``;
+* :mod:`~distkeras_tpu.netps.fold` — the ONE server-side fold shared with
+  the in-process raced twin (``racelab``), so raced-parity evidence
+  transfers to the network server by construction;
+* :mod:`~distkeras_tpu.netps.remote` — the worker loop the async trainers
+  run under ``remote="host:port"`` (pull -> K jitted local steps ->
+  commit).
+
+Run a standalone server with ``python -m distkeras_tpu.netps``; docs in
+docs/RESILIENCE.md ("Network faults & elastic membership").
+"""
+
+from __future__ import annotations
+
+from distkeras_tpu.netps.chaos import ChaosProxy  # noqa: F401
+from distkeras_tpu.netps.client import CommitResult, PSClient  # noqa: F401
+from distkeras_tpu.netps.errors import (  # noqa: F401
+    LeaseExpiredError,
+    NetPSError,
+    ProtocolError,
+    RPCTimeoutError,
+    ServerClosedError,
+    ServerDrainingError,
+)
+from distkeras_tpu.netps.fold import (  # noqa: F401
+    SUPPORTED_DISCIPLINES,
+    commit_scale,
+    fold_delta,
+)
+from distkeras_tpu.netps.server import PSServer, serve  # noqa: F401
+
+__all__ = [
+    "PSServer", "serve", "PSClient", "CommitResult", "ChaosProxy",
+    "NetPSError", "ProtocolError", "RPCTimeoutError", "ServerDrainingError",
+    "LeaseExpiredError", "ServerClosedError",
+    "SUPPORTED_DISCIPLINES", "commit_scale", "fold_delta",
+]

@@ -1,0 +1,56 @@
+"""CLI: run a standalone netps parameter server.
+
+``Job``/``Punchcard`` launch this on the PS host of a pod::
+
+    python -m distkeras_tpu.netps --host 0.0.0.0 --port 7077 \
+        --discipline adag --lease 10
+
+The server starts uninitialized — the first worker's ``join`` seeds the
+center with its model parameters, so this process needs no model (or jax)
+knowledge. It prints ``NETPS_READY <host:port>`` once listening and runs
+until SIGTERM/SIGINT, then drains gracefully (in-flight commits finish,
+late clients get a typed ``ServerDrainingError``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from distkeras_tpu.netps.fold import SUPPORTED_DISCIPLINES
+from distkeras_tpu.netps.server import PSServer
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m distkeras_tpu.netps",
+        description="Standalone networked parameter server.")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=7077)
+    ap.add_argument("--discipline", default="adag",
+                    choices=sorted(SUPPORTED_DISCIPLINES))
+    ap.add_argument("--lease", type=float, default=None,
+                    help="membership lease seconds (default DKTPU_PS_LEASE)")
+    args = ap.parse_args(argv)
+    server = PSServer(discipline=args.discipline, host=args.host,
+                      port=args.port, lease_s=args.lease).start()
+    stop = threading.Event()
+
+    def _stop(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    print(f"NETPS_READY {server.endpoint}", flush=True)
+    stop.wait()
+    server.close()
+    print(f"NETPS_DRAINED commits={len(server.commit_log)} "
+          f"evictions={server.evictions} rejoins={server.rejoins}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

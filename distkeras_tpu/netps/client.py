@@ -1,0 +1,281 @@
+"""The hardened parameter-server client: every edge guarded.
+
+Where the reference's worker did ``socket.connect(); send(pickle)`` and
+hoped, every RPC here has
+
+* a **deadline** — ``DKTPU_NET_TIMEOUT`` seconds per attempt, covering
+  connect, send, and the full reply;
+* **bounded retries with exponential backoff + full jitter** —
+  ``DKTPU_NET_RETRIES`` attempts spaced by
+  :func:`~distkeras_tpu.resilience.backoff.full_jitter` over a
+  ``DKTPU_NET_BACKOFF``-based envelope, so W workers cut off by the same
+  partition do not retry in lockstep;
+* **idempotent commit sequencing** — the client assigns ``(worker_id,
+  seq)`` *before* the first send and reuses it on every retransmit, so a
+  commit whose ACK was lost is folded exactly once (the server dedups and
+  answers ``duplicate=True``);
+* **automatic re-join** — an RPC rejected with ``lease_expired`` (the
+  server evicted us while we were away) triggers a fresh ``join``; ``pull``
+  then simply returns the re-joined center, while ``commit`` reports
+  ``evicted=True`` so the worker loop discards its stale window and
+  continues from a fresh pull.
+
+A failed attempt always tears the connection down and reconnects — stale
+bytes die with the old socket, and the ``req`` id echo discards any
+duplicate replies that survive on a healthy one. Typed, **non-retryable**
+failures (:class:`ServerDrainingError`, :class:`LeaseExpiredError`)
+surface immediately.
+
+One client serves one worker thread; it is deliberately not thread-safe
+(the reference's one-socket-per-worker layout).
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from distkeras_tpu.netps import wire
+from distkeras_tpu.netps.errors import (
+    LeaseExpiredError,
+    NetPSError,
+    ProtocolError,
+    RPCTimeoutError,
+    ServerClosedError,
+    ServerDrainingError,
+)
+from distkeras_tpu.resilience.backoff import full_jitter
+from distkeras_tpu.runtime import config
+
+#: server error kind -> typed exception. Everything here is NON-retryable:
+#: the server answered, it just said no.
+_ERROR_TYPES = {
+    "draining": ServerDrainingError,
+    "lease_expired": LeaseExpiredError,
+    "uninitialized": NetPSError,
+    "protocol": ProtocolError,
+}
+
+
+class CommitResult(NamedTuple):
+    """What happened to one commit: ``applied`` (folded now),
+    ``duplicate`` (folded by an earlier retransmit — still success),
+    ``evicted`` (lease expired; the window was discarded and the client
+    re-joined — pull fresh and continue)."""
+
+    applied: bool
+    duplicate: bool
+    evicted: bool
+    updates: int
+    staleness: int
+
+
+class PSClient:
+    """One worker's connection to a :class:`~distkeras_tpu.netps.server.
+    PSServer` (or anything speaking the wire protocol, e.g. the chaos
+    proxy). ``timeout``/``retries``/``backoff`` default from the registry
+    (`DKTPU_NET_TIMEOUT` / `DKTPU_NET_RETRIES` / `DKTPU_NET_BACKOFF`)."""
+
+    def __init__(self, endpoint: str, worker_id: Optional[int] = None,
+                 timeout: Optional[float] = None,
+                 retries: Optional[int] = None,
+                 backoff: Optional[float] = None,
+                 auto_rejoin: bool = True):
+        self._host, self._port = wire.split_endpoint(endpoint)
+        self.endpoint = endpoint
+        self.worker_id = worker_id
+        self.timeout = float(timeout if timeout is not None
+                             else config.env_float("DKTPU_NET_TIMEOUT"))
+        self.retries = int(retries if retries is not None
+                           else config.env_int("DKTPU_NET_RETRIES"))
+        self.backoff = float(backoff if backoff is not None
+                             else config.env_float("DKTPU_NET_BACKOFF"))
+        self.auto_rejoin = auto_rejoin
+        self.lease_s: Optional[float] = None
+        self._sock: Optional[socket.socket] = None
+        self._req = 0
+        self._seq = -1
+        self._closed = False
+        self._ever_connected = False
+        #: times this client re-joined after an eviction (worker loops
+        #: watch it to re-adopt the center on rejoin).
+        self.rejoin_count = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        self._closed = True
+        self._disconnect()
+
+    def __enter__(self) -> "PSClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _connect(self, deadline: float) -> socket.socket:
+        if self._sock is not None:
+            return self._sock
+        from distkeras_tpu import telemetry
+
+        if self._ever_connected:
+            telemetry.counter("netps.reconnects").add(1)
+        # The connect spends from the SAME per-attempt budget as the send
+        # and reply (the documented contract): against a SYN-blackholing
+        # partition, connect-then-wait must not cost 2x the deadline.
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise socket.timeout("deadline exceeded before connect")
+        sock = socket.create_connection((self._host, self._port),
+                                        timeout=remaining)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        self._ever_connected = True
+        return sock
+
+    def _disconnect(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    # -- the guarded RPC core ----------------------------------------------
+    def _rpc(self, op: str, header: dict,
+             arrays: Sequence[np.ndarray] = ()) -> tuple[dict, list]:
+        if self._closed:
+            raise ServerClosedError(f"client to {self.endpoint} is closed")
+        from distkeras_tpu import telemetry
+
+        attempts = self.retries + 1
+        last_exc: Optional[BaseException] = None
+        with telemetry.span(f"netps.rpc.{op}"):
+            for attempt in range(attempts):
+                self._req += 1
+                req = self._req
+                hdr = dict(header, op=op, req=req)
+                if self.worker_id is not None:
+                    hdr.setdefault("worker_id", int(self.worker_id))
+                try:
+                    return self._attempt(req, hdr, arrays)
+                except (socket.timeout, ConnectionError, OSError,
+                        ProtocolError) as e:
+                    last_exc = e
+                    self._disconnect()
+                    if attempt + 1 < attempts:
+                        telemetry.counter("netps.retries").add(1)
+                        time.sleep(full_jitter(self.backoff, attempt))
+        telemetry.counter("netps.rpc_failures").add(1)
+        raise RPCTimeoutError(
+            f"{op} to {self.endpoint} failed after {attempts} attempts "
+            f"(last: {type(last_exc).__name__}: {last_exc})",
+            attempts=attempts)
+
+    def _attempt(self, req: int, hdr: dict,
+                 arrays: Sequence[np.ndarray]) -> tuple[dict, list]:
+        """One connect + send + matched-reply receive under ONE deadline."""
+        from distkeras_tpu import telemetry
+
+        deadline = time.monotonic() + self.timeout
+        sock = self._connect(deadline)
+        sock.settimeout(max(0.001, deadline - time.monotonic()))
+        sent = wire.send_frame(sock, wire.KIND_REQUEST, hdr, arrays)
+        telemetry.counter("netps.bytes_sent").add(sent)
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout(f"{hdr['op']} deadline exceeded")
+            sock.settimeout(remaining)
+            raw = wire.read_raw_frame(sock)
+            kind, rhdr, rarrays = wire.decode_frame(raw)
+            if kind != wire.KIND_REPLY:
+                raise ProtocolError(f"expected a reply frame, got kind {kind}")
+            if rhdr.get("req") != req:
+                # A duplicated or late reply (chaos `dup`): discard and keep
+                # reading — the req echo is what keeps the stream sane.
+                telemetry.counter("netps.stale_replies").add(1)
+                continue
+            telemetry.counter("netps.bytes_received").add(len(raw))
+            err = rhdr.get("error")
+            if err:
+                exc = _ERROR_TYPES.get(err, NetPSError)
+                raise exc(f"{hdr['op']}: server said {err}: "
+                          f"{rhdr.get('message', '')}")
+            return rhdr, rarrays
+
+    # -- RPC surface --------------------------------------------------------
+    def join(self, init: Optional[Sequence[np.ndarray]] = None,
+             ) -> tuple[list, int]:
+        """Become (or re-become) a member; returns ``(center, updates)``.
+        ``init`` seeds an uninitialized server (first joiner wins; later
+        inits are ignored — everyone adopts the server's center)."""
+        hdr, center = self._rpc("join", {}, list(init or ()))
+        self.worker_id = int(hdr["worker_id"])
+        self.lease_s = hdr.get("lease_s")
+        # Resume the commit sequence past what the server already folded
+        # from this worker_id: a restarted worker process starts at seq -1,
+        # and without adopting the server's high-water mark every commit of
+        # the new incarnation would be deduped away as a "retransmit".
+        server_seq = int(hdr.get("last_seq", -1))
+        if server_seq > self._seq:
+            self._seq = server_seq
+        return center, int(hdr["updates"])
+
+    def pull(self) -> tuple[list, int]:
+        """Current center + update counter; renews the lease. An evicted
+        client transparently re-joins first (``auto_rejoin``)."""
+        try:
+            hdr, center = self._rpc("pull", {})
+        except LeaseExpiredError:
+            if not self.auto_rejoin:
+                raise
+            self.rejoin_count += 1
+            return self.join()
+        return center, int(hdr["updates"])
+
+    def commit(self, delta: Sequence[np.ndarray],
+               pulled_counter: int) -> CommitResult:
+        """Fold ``delta`` (worker-normalized) into the center. The seq is
+        assigned before the first transmission and reused across retries:
+        a lost ACK can never double-fold."""
+        self._seq += 1
+        seq = self._seq
+        try:
+            hdr, _ = self._rpc(
+                "commit", {"seq": seq, "pulled": int(pulled_counter)},
+                list(delta))
+        except LeaseExpiredError:
+            if not self.auto_rejoin:
+                raise
+            self.rejoin_count += 1
+            self.join()
+            return CommitResult(applied=False, duplicate=False, evicted=True,
+                                updates=-1, staleness=-1)
+        return CommitResult(
+            applied=bool(hdr.get("applied")),
+            duplicate=bool(hdr.get("duplicate")),
+            evicted=False, updates=int(hdr["updates"]),
+            staleness=int(hdr.get("staleness", -1)))
+
+    def heartbeat(self) -> int:
+        """Renew the lease; returns the server's update counter."""
+        try:
+            hdr, _ = self._rpc("heartbeat", {})
+        except LeaseExpiredError:
+            if not self.auto_rejoin:
+                raise
+            self.rejoin_count += 1
+            _center, updates = self.join()
+            return updates
+        return int(hdr["updates"])
+
+    def leave(self) -> None:
+        """Best-effort clean departure (a dead server is not an error —
+        leaving was the goal)."""
+        try:
+            self._rpc("leave", {})
+        except (NetPSError, OSError):
+            pass

@@ -1,0 +1,53 @@
+"""The one shared server-side fold: commit discipline semantics.
+
+Both parameter-server stand-ins — the in-process raced twin
+(:class:`distkeras_tpu.racelab.RacedParameterServer`) and the networked
+:class:`distkeras_tpu.netps.server.PSServer` — fold a worker's commit into
+the center through THIS function, so the raced-parity evidence
+(``tests/test_raced_ps.py``: raced PS vs deterministic window folds agree)
+transfers to the network server by construction: same fold, different
+transport.
+
+Division of labor mirrors the reference exactly (SURVEY.md §3.3/§3.4): the
+*worker* pre-normalizes its commit (ADAG divides by the window, the elastic
+disciplines send ``e = α·(w − center)``), and the *server* applies one
+scale — ``1/(staleness+1)`` for DynSGD, identity for everything else — and
+adds. Staleness is the server's update counter minus the committer's
+pull-time counter.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+#: every discipline either PS stand-in accepts (the reference routed both
+#: elastic trainers through the plain DeltaParameterServer — the fold is
+#: identical; elasticity lives worker-side).
+SUPPORTED_DISCIPLINES = ("downpour", "adag", "dynsgd", "aeasgd", "eamsgd")
+
+
+def check_discipline(discipline: str) -> str:
+    if discipline not in SUPPORTED_DISCIPLINES:
+        raise ValueError(
+            f"unsupported PS discipline {discipline!r}; "
+            f"known: {list(SUPPORTED_DISCIPLINES)}")
+    return discipline
+
+
+def commit_scale(discipline: str, staleness: int) -> float:
+    """The server-side scale applied to a commit folded ``staleness``
+    updates after its pull (DynSGD's counter semantics; 1.0 otherwise)."""
+    if discipline == "dynsgd":
+        return 1.0 / (float(staleness) + 1.0)
+    return 1.0
+
+
+def fold_delta(center: Sequence[np.ndarray], delta: Sequence[np.ndarray],
+               discipline: str, staleness: int) -> None:
+    """Fold one worker-normalized commit into ``center`` **in place** —
+    the body of the reference's ``handle_commit`` under the lock."""
+    scale = commit_scale(discipline, staleness)
+    for c, d in zip(center, delta):
+        c += scale * np.asarray(d, c.dtype)

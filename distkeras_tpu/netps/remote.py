@@ -1,0 +1,186 @@
+"""The remote worker loop: the reference's executor loop over the real wire.
+
+Each logical worker is a host thread running ``pull -> K local steps ->
+commit`` against a :class:`~distkeras_tpu.netps.server.PSServer` through
+the hardened :class:`~distkeras_tpu.netps.client.PSClient` — the same
+jitted window (:func:`distkeras_tpu.workers.make_local_loop`) the engines
+compile, the same worker-side discipline normalization the raced twin
+uses (``racelab.run_raced``), and the same server-side fold
+(:mod:`distkeras_tpu.netps.fold`). Gradient compute releases the GIL, so
+worker threads genuinely interleave; commit order is whatever the network
+and the OS deliver — the reference's architecture, end to end.
+
+Elastic membership in the loop: a worker that went silent past its lease
+(injected via the ``evict@R:S`` net fault, or a real stall) finds itself
+evicted at the next RPC; the client re-joins automatically, the worker
+discards its stale window, re-adopts the freshly pulled center (the
+reference's rejoining-worker semantics), and training continues — no
+global restart, and the survivors never stopped.
+
+Mutable model state (BatchNorm stats) stays per-worker and unsynced here —
+the reference's socket server only ever carried parameters.
+
+Worker identity: ids 0..W-1 are per-*trainer*. A restarted worker process
+resumes safely (``join`` hands back the server's last folded seq), but two
+hosts pointing ``run_remote`` at one server would collide on ids — give
+each host a disjoint id range (or its own server) until multi-host id
+assignment is plumbed through ``Job``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from distkeras_tpu.data.batching import BatchPlan, apply_round_transform
+from distkeras_tpu.netps.client import PSClient
+from distkeras_tpu.netps.fold import check_discipline
+from distkeras_tpu.resilience import faults as _faults
+
+
+def _leaves(tree) -> list:
+    import jax
+
+    return [np.asarray(a, np.float32) for a in jax.tree.leaves(tree)]
+
+
+def _worker_round(plan: BatchPlan, r: int, w: int):
+    """Worker ``w``'s ``[K, B, ...]`` slice of round ``r`` (each thread
+    gathers only its own rows — the per-executor partition)."""
+    idx = plan.index[r, w]
+    xs, ys = plan.x[idx], plan.y[idx]
+    if plan.transform is not None:
+        xs4, ys4 = apply_round_transform(
+            plan.transform, plan.transform_seed, r, [w],
+            xs[None], ys[None])
+        xs, ys = xs4[0], ys4[0]
+    return xs, ys
+
+
+def run_remote(
+    *,
+    endpoint: str,
+    model,
+    tx,
+    loss_fn,
+    plan: BatchPlan,
+    discipline: str = "adag",
+    window: int,
+    alpha: float = 0.05,
+    seed: int = 0,
+    compute_dtype=None,
+    grad_accum: int = 1,
+    timeout: Optional[float] = None,
+    retries: Optional[int] = None,
+    backoff: Optional[float] = None,
+) -> tuple[Any, np.ndarray]:
+    """Train ``plan.num_workers`` threads against the PS at ``endpoint``.
+
+    Returns ``(trained_params_tree, losses[rounds, W])`` — the params are
+    the server's final center. Rows of ``losses`` for a round a worker's
+    commit was discarded (eviction) still carry that worker's local loss;
+    NaN marks rounds a worker never ran (it was asleep being evicted).
+
+    The first joiner seeds an uninitialized server with this model's
+    params, so a bare ``python -m distkeras_tpu.netps`` server needs no
+    model knowledge.
+    """
+    import jax
+
+    from distkeras_tpu import telemetry
+    from distkeras_tpu.workers import make_local_loop
+
+    check_discipline(discipline)
+    W = plan.num_workers
+    elastic = discipline in ("aeasgd", "eamsgd")
+    treedef = jax.tree.structure(model.params)
+    init_leaves = _leaves(model.params)
+    loop_fn = jax.jit(make_local_loop(
+        model.module, loss_fn, tx, compute_dtype=compute_dtype,
+        state_collections=model.state_collections, grad_accum=grad_accum))
+    losses = np.full((plan.num_rounds, W), np.nan, np.float32)
+    errors: list = []
+    base_key = jax.random.key(seed)
+
+    def unflatten(leaves):
+        return jax.tree.unflatten(treedef, [np.asarray(a) for a in leaves])
+
+    def work(w: int) -> None:
+        client = PSClient(endpoint, worker_id=w, timeout=timeout,
+                          retries=retries, backoff=backoff)
+        try:
+            center_leaves, counter = client.join(init=init_leaves)
+            params = unflatten(center_leaves)
+            opt_state = tx.init(params)
+            local = params if elastic else None
+            mstate = (jax.tree.map(np.asarray, model.state)
+                      if model.state is not None else None)
+            readopt = False
+            rejoins_seen = 0
+            for r in range(plan.num_rounds):
+                net = _faults.active_net_plan()
+                if net is not None and net.poison_worker(r, W) == w:
+                    arg = net.fire("evict", r)
+                    if arg is not None:
+                        # Go silent past the lease: the server evicts us;
+                        # the next RPC re-joins and we continue.
+                        lease = client.lease_s or 1.0
+                        time.sleep(arg if arg > 0 else 2.0 * lease)
+                pulled_leaves, counter = client.pull()
+                if client.rejoin_count > rejoins_seen or readopt:
+                    # Evicted while away: the rejoining worker re-adopts
+                    # the center (fresh replica + optimizer — the
+                    # reference's PS-pull join semantics).
+                    rejoins_seen = client.rejoin_count
+                    readopt = False
+                    if elastic:
+                        local = unflatten(pulled_leaves)
+                        opt_state = tx.init(local)
+                start = local if elastic else unflatten(pulled_leaves)
+                xs, ys = _worker_round(plan, r, w)
+                rng = jax.random.fold_in(jax.random.fold_in(base_key, w), r)
+                new_params, opt_state, mstate, window_losses = loop_fn(
+                    start, opt_state, xs, ys, rng, mstate)
+                new_leaves = _leaves(new_params)
+                pulled_np = [np.asarray(a, np.float32)
+                             for a in pulled_leaves]
+                if elastic:
+                    e = [alpha * (n - p)
+                         for n, p in zip(new_leaves, pulled_np)]
+                    local = unflatten([n - d
+                                       for n, d in zip(new_leaves, e)])
+                    res = client.commit(e, counter)
+                else:
+                    delta = [n - p for n, p in zip(new_leaves, pulled_np)]
+                    if discipline == "adag":
+                        delta = [d / float(window) for d in delta]
+                    res = client.commit(delta, counter)
+                if res.evicted:
+                    # The lease lapsed inside this window: the commit was
+                    # discarded and the client already re-joined. Start
+                    # over from the fresh center next round.
+                    readopt = True
+                losses[r, w] = float(np.mean(np.asarray(window_losses)))
+            client.leave()
+        except BaseException as e:  # noqa: BLE001 - surface on main thread
+            errors.append(e)
+        finally:
+            client.close()
+
+    with telemetry.span("netps.remote_train"):
+        threads = [threading.Thread(target=work, args=(w,),
+                                    name=f"netps-worker-{w}")
+                   for w in range(W)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    if errors:
+        raise errors[0]
+    with PSClient(endpoint, timeout=timeout, retries=retries,
+                  backoff=backoff) as observer:
+        final_leaves, _updates = observer.pull()
+    return unflatten(final_leaves), losses

@@ -1,0 +1,360 @@
+"""The networked parameter server: the reference's socket architecture, hardened.
+
+``DeltaParameterServer``/``ADAGParameterServer`` re-created for real: a TCP
+listener, **one handler thread per connection**, and a center variable
+folded under a plain lock — but with the production edges the reference
+never had:
+
+* **Idempotent commits.** Every commit carries a client-assigned
+  ``(worker_id, seq)``; the server folds a given seq at most once and
+  answers a retransmit (lost ACK) with ``applied=False, duplicate=True``.
+  The retry path is therefore exactly-once *in effect* on an at-least-once
+  transport — assert it on :attr:`PSServer.commit_log`.
+* **Lease-based elastic membership.** ``join`` grants a lease; ``pull`` /
+  ``commit`` / ``heartbeat`` renew it; a monitor thread evicts workers whose
+  lease expires. Training continues with the survivors, and an evicted (or
+  brand-new) worker can ``join`` mid-run and pull the current center — no
+  global restart.
+* **Graceful drain.** :meth:`close` stops accepting commits (clients get a
+  typed ``ServerDrainingError``), lets in-flight handler frames finish,
+  then tears the listener and every thread down (all joined — nothing
+  leaks past close).
+
+The fold itself is :func:`distkeras_tpu.netps.fold.fold_delta` — the same
+function the in-process raced twin uses, so raced-parity evidence
+transfers. The server is numpy + stdlib only: it runs as its own process
+(``python -m distkeras_tpu.netps``) with no jax dependency on the hot path.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from distkeras_tpu.netps import wire
+from distkeras_tpu.netps.errors import ProtocolError
+from distkeras_tpu.netps.fold import check_discipline, fold_delta
+from distkeras_tpu.runtime import config
+
+#: handler/accept poll tick: how often blocked threads wake to check stop.
+_POLL_S = 0.2
+#: once a frame's first bytes arrive, the rest must land within this —
+#: a peer that stalls mid-frame is dead, not idle.
+_FRAME_COMPLETE_S = 30.0
+
+
+class PSServer:
+    """One center variable served over TCP to N worker clients.
+
+    ``center=None`` starts uninitialized: the first ``join`` carrying init
+    arrays seeds it (so a CLI-launched server needs no model knowledge —
+    the workers bring the parameters). ``lease_s`` defaults to
+    ``DKTPU_PS_LEASE``.
+    """
+
+    def __init__(self, center: Optional[Sequence[np.ndarray]] = None,
+                 discipline: str = "adag", host: str = "127.0.0.1",
+                 port: int = 0, lease_s: Optional[float] = None):
+        self.discipline = check_discipline(discipline)
+        self._lock = threading.Lock()
+        self._center = (None if center is None
+                        else [np.array(a, np.float32) for a in center])
+        self._updates = 0
+        self.lease_s = float(lease_s if lease_s is not None
+                             else config.env_float("DKTPU_PS_LEASE"))
+        #: worker_id -> lease deadline (monotonic seconds).
+        self._members: dict = {}
+        #: worker_id -> highest folded commit seq (survives eviction, so a
+        #: pre-eviction retransmit is still deduped after a rejoin).
+        self._last_seq: dict = {}
+        #: every worker_id ever admitted (rejoin accounting + id assignment).
+        self._ever: set = set()
+        #: applied commits in fold order: (worker_id, seq, staleness) — the
+        #: exactly-once evidence the chaos tests assert on.
+        self.commit_log: list = []
+        self.evictions = 0
+        self.rejoins = 0
+        self._draining = False
+        self._stop = threading.Event()
+        self._listener = socket.create_server((host, port))
+        self._listener.settimeout(_POLL_S)
+        self._host = host
+        self._port = self._listener.getsockname()[1]
+        self._threads: list = []
+        self._accept_thread: Optional[threading.Thread] = None
+        self._monitor_thread: Optional[threading.Thread] = None
+        self._started = False
+
+    # ------------------------------------------------------------------
+    @property
+    def endpoint(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    @property
+    def updates(self) -> int:
+        return self._updates
+
+    def center(self) -> list:
+        with self._lock:
+            if self._center is None:
+                return []
+            return [a.copy() for a in self._center]
+
+    def members(self) -> list:
+        with self._lock:
+            return sorted(self._members)
+
+    # ------------------------------------------------------------------
+    def start(self) -> "PSServer":
+        """Begin accepting connections (idempotent)."""
+        if self._started:
+            return self
+        self._started = True
+        t = threading.Thread(target=self._accept_loop,
+                             name="netps-accept")
+        t.start()
+        self._accept_thread = t
+        t = threading.Thread(target=self._monitor_loop,
+                             name="netps-monitor")
+        t.start()
+        self._monitor_thread = t
+        return self
+
+    def drain(self) -> None:
+        """Enter draining mode: commits and joins are rejected with a typed
+        ``ServerDrainingError``; pulls still serve (departing workers may
+        fetch the final center). In-flight folds finish — the flip
+        serializes behind any commit holding the lock."""
+        with self._lock:
+            self._draining = True
+
+    def close(self) -> None:
+        """Graceful shutdown: :meth:`drain`, then stop and join every
+        thread (accept loop, per-connection handlers, lease monitor) and
+        release the listener. Idempotent."""
+        self.drain()
+        self._stop.set()
+        if self._accept_thread is not None:
+            self._accept_thread.join()
+        if self._monitor_thread is not None:
+            self._monitor_thread.join()
+        for t in list(self._threads):
+            t.join()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed under us
+            conn.settimeout(_POLL_S)
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 name="netps-handler")
+            t.start()
+            self._threads.append(t)
+
+    def _monitor_loop(self) -> None:
+        """Evict members whose lease expired; training continues with the
+        survivors (the Spark-driver failure-detection half, made explicit)."""
+        from distkeras_tpu import telemetry
+
+        tick = max(0.05, min(self.lease_s / 4.0, _POLL_S))
+        while not self._stop.wait(tick):
+            now = time.monotonic()
+            with self._lock:
+                expired = [w for w, dl in self._members.items() if dl < now]
+                for w in expired:
+                    del self._members[w]
+                    self.evictions += 1
+            for w in expired:
+                telemetry.counter("netps.evictions").add(1)
+                telemetry.event("netps_eviction", {"worker": w})
+
+    # ------------------------------------------------------------------
+    def _handle(self, conn: socket.socket) -> None:
+        """One connection's handler thread — the reference's
+        ``handle_commit`` loop, framed and checksummed. Polls for the first
+        byte of each frame (so ``close()`` can stop it) and switches to a
+        completion timeout once a frame starts — a half-arrived frame never
+        desyncs back into the idle poll."""
+        from distkeras_tpu import telemetry
+
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    prefix = wire.recv_exact(conn, wire.PREFIX_SIZE)
+                except socket.timeout:
+                    continue
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    conn.settimeout(_FRAME_COMPLETE_S)
+                    raw = wire.finish_raw_frame(conn, prefix)
+                    conn.settimeout(_POLL_S)
+                    kind, header, arrays = wire.decode_frame(raw)
+                except (socket.timeout, ConnectionError, OSError):
+                    return
+                except ProtocolError:
+                    # Stream can never re-align: drop the connection. The
+                    # client reconnects and retries.
+                    telemetry.counter("netps.protocol_errors").add(1)
+                    return
+                if kind != wire.KIND_REQUEST:
+                    telemetry.counter("netps.protocol_errors").add(1)
+                    return
+                telemetry.counter("netps.bytes_received").add(len(raw))
+                op = header.get("op", "")
+                with telemetry.span(f"netps.server.{op or 'unknown'}"):
+                    reply, out = self._dispatch(op, header, arrays)
+                reply["req"] = header.get("req")
+                try:
+                    sent = wire.send_frame(conn, wire.KIND_REPLY, reply, out)
+                except (ConnectionError, OSError):
+                    return
+                telemetry.counter("netps.bytes_sent").add(sent)
+
+    def _dispatch(self, op: str, header: dict,
+                  arrays: list) -> tuple[dict, list]:
+        if op == "join":
+            return self._op_join(header, arrays)
+        if op == "pull":
+            return self._op_pull(header)
+        if op == "commit":
+            return self._op_commit(header, arrays)
+        if op == "heartbeat":
+            return self._op_heartbeat(header)
+        if op == "leave":
+            return self._op_leave(header)
+        return {"error": "protocol", "message": f"unknown op {op!r}"}, []
+
+    @staticmethod
+    def _err(kind: str, message: str) -> tuple[dict, list]:
+        return {"error": kind, "message": message}, []
+
+    def _op_join(self, header: dict, arrays: list) -> tuple[dict, list]:
+        from distkeras_tpu import telemetry
+
+        wid = header.get("worker_id")
+        rejoin = False
+        with self._lock:
+            if self._draining:
+                return self._err("draining", "server is draining")
+            if wid is None:
+                wid = (max(self._ever) + 1) if self._ever else 0
+            wid = int(wid)
+            rejoin = wid in self._ever and wid not in self._members
+            if self._center is None and arrays:
+                self._center = [np.array(a, np.float32) for a in arrays]
+            if self._center is None:
+                return self._err(
+                    "uninitialized",
+                    "server has no center yet; join with init arrays")
+            self._ever.add(wid)
+            self._members[wid] = time.monotonic() + self.lease_s
+            if rejoin:
+                self.rejoins += 1
+            center = [a.copy() for a in self._center]
+            updates = self._updates
+            last_seq = self._last_seq.get(wid, -1)
+        if rejoin:
+            telemetry.counter("netps.rejoins").add(1)
+            telemetry.event("netps_rejoin", {"worker": wid})
+        # last_seq lets a RESTARTED worker process (fresh client, seq
+        # counter back at -1) resume its sequence past what this server
+        # already folded — without it, dedup would silently discard every
+        # commit of the restarted incarnation forever.
+        return ({"ok": True, "worker_id": wid, "updates": updates,
+                 "lease_s": self.lease_s, "last_seq": last_seq}, center)
+
+    def _op_pull(self, header: dict) -> tuple[dict, list]:
+        wid = header.get("worker_id")
+        with self._lock:
+            if self._center is None:
+                return self._err("uninitialized", "no center yet")
+            if wid is not None:
+                # Members renew their lease by pulling; an evicted worker
+                # must rejoin first. wid=None is an anonymous observer pull
+                # (the trainer fetching the final center) — no lease.
+                if int(wid) not in self._members:
+                    return self._err(
+                        "lease_expired", f"worker {wid} is not a member")
+                self._members[int(wid)] = time.monotonic() + self.lease_s
+            return ({"ok": True, "updates": self._updates},
+                    [a.copy() for a in self._center])
+
+    def _op_commit(self, header: dict, arrays: list) -> tuple[dict, list]:
+        from distkeras_tpu import telemetry
+
+        wid = header.get("worker_id")
+        seq = header.get("seq")
+        pulled = header.get("pulled", 0)
+        if wid is None or seq is None:
+            return self._err("protocol", "commit requires worker_id and seq")
+        wid, seq = int(wid), int(seq)
+        duplicate = False
+        with self._lock:
+            if self._draining:
+                return self._err("draining", "server is draining")
+            if wid not in self._members:
+                return self._err(
+                    "lease_expired", f"worker {wid} is not a member")
+            if self._center is None:
+                return self._err("uninitialized", "no center yet")
+            self._members[wid] = time.monotonic() + self.lease_s
+            if seq <= self._last_seq.get(wid, -1):
+                # Retransmit after a lost ACK: already folded. Answering
+                # applied=False (instead of re-folding) is the whole
+                # exactly-once story.
+                duplicate = True
+                staleness = -1
+            else:
+                staleness = self._updates - int(pulled)
+                fold_delta(self._center, arrays, self.discipline, staleness)
+                self.commit_log.append((wid, seq, staleness))
+                self._last_seq[wid] = seq
+                self._updates += 1
+            updates = self._updates
+        if duplicate:
+            telemetry.counter("netps.commits_deduped").add(1)
+        else:
+            telemetry.counter("netps.commits").add(1)
+        return ({"ok": True, "applied": not duplicate,
+                 "duplicate": duplicate, "updates": updates,
+                 "staleness": staleness}, [])
+
+    def _op_heartbeat(self, header: dict) -> tuple[dict, list]:
+        wid = header.get("worker_id")
+        if wid is None:
+            return self._err("protocol", "heartbeat requires worker_id")
+        with self._lock:
+            if int(wid) not in self._members:
+                return self._err(
+                    "lease_expired", f"worker {wid} is not a member")
+            self._members[int(wid)] = time.monotonic() + self.lease_s
+            return {"ok": True, "updates": self._updates}, []
+
+    def _op_leave(self, header: dict) -> tuple[dict, list]:
+        wid = header.get("worker_id")
+        with self._lock:
+            if wid is not None:
+                self._members.pop(int(wid), None)
+        return {"ok": True}, []
+
+
+def serve(center: Optional[Sequence[np.ndarray]] = None,
+          discipline: str = "adag", host: str = "127.0.0.1",
+          port: int = 0, lease_s: Optional[float] = None) -> PSServer:
+    """Construct + start a :class:`PSServer` (tests and the CLI)."""
+    return PSServer(center, discipline=discipline, host=host, port=port,
+                    lease_s=lease_s).start()

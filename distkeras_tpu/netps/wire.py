@@ -1,0 +1,200 @@
+"""The hardened wire protocol: length-prefixed, checksummed binary frames.
+
+The reference shipped pickles over raw TCP (``distkeras/networking.py``)
+and trusted every byte; this framing trusts nothing. One frame::
+
+    MAGIC(2)='DK'  VERSION(1)  KIND(1)  CRC32(4)  LENGTH(4)  BODY(LENGTH)
+
+and BODY is ``HLEN(4) + JSON header (HLEN bytes, utf-8) + raw array
+buffers`` — array dtype/shape ride in the header (``arrays`` field), the
+buffers follow in order, so a parameter pull is one contiguous write with
+zero pickling.
+
+Hardening, in the order an attacker (or the chaos proxy) meets it:
+
+* **magic + version**: a stray client or a mid-stream desync fails in the
+  first 3 bytes, not after a multi-GiB allocation;
+* **bounded length**: frames above ``DKTPU_NET_MAX_FRAME`` are rejected
+  before any allocation;
+* **crc32 over the body**: a truncated or bit-flipped frame (chaos
+  ``truncate``) raises :class:`ProtocolError` instead of folding garbage
+  into the center;
+* **request ids**: every request carries a client-assigned ``req``; replies
+  echo it, and the client discards non-matching replies — a duplicated
+  frame (chaos ``dup``) cannot desynchronize the request/reply stream.
+
+After any :class:`ProtocolError` the connection is dead by contract: the
+byte stream cannot re-align, so both ends tear down (the client then
+reconnects and retries). Timeouts (``socket.timeout``) propagate to the
+caller — the server's handler polls for the *first* byte of a frame and
+switches to a completion timeout once one arrives; the client budget-boxes
+the whole reply.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import zlib
+from typing import Optional, Sequence
+
+import numpy as np
+
+from distkeras_tpu.netps.errors import ProtocolError
+from distkeras_tpu.runtime import config
+
+MAGIC = b"DK"
+VERSION = 1
+#: frame kinds — the one-byte fast-reject before the JSON header is parsed.
+KIND_REQUEST = 1
+KIND_REPLY = 2
+
+_PREFIX = struct.Struct("!2sBBII")  # magic, version, kind, crc32, body length
+PREFIX_SIZE = _PREFIX.size
+
+
+def max_frame_bytes() -> int:
+    return config.env_int("DKTPU_NET_MAX_FRAME")
+
+
+def encode_frame(kind: int, header: dict,
+                 arrays: Sequence[np.ndarray] = ()) -> bytes:
+    """Serialize ``header`` + ``arrays`` into one checksummed frame."""
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    header = dict(header)
+    header["arrays"] = [{"dtype": a.dtype.str, "shape": list(a.shape)}
+                        for a in arrays]
+    hjson = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    body = b"".join([struct.pack("!I", len(hjson)), hjson,
+                     *(a.tobytes() for a in arrays)])
+    return _PREFIX.pack(MAGIC, VERSION, kind, zlib.crc32(body),
+                        len(body)) + body
+
+
+def parse_prefix(prefix: bytes,
+                 max_frame: Optional[int] = None) -> tuple[int, int, int]:
+    """Validate a 12-byte frame prefix -> (kind, crc32, body_length)."""
+    magic, version, kind, crc, length = _PREFIX.unpack(prefix)
+    if magic != MAGIC:
+        raise ProtocolError(f"bad magic {magic!r}")
+    if version != VERSION:
+        raise ProtocolError(f"unsupported protocol version {version}")
+    if kind not in (KIND_REQUEST, KIND_REPLY):
+        raise ProtocolError(f"unknown frame kind {kind}")
+    limit = max_frame if max_frame is not None else max_frame_bytes()
+    if length > limit:
+        raise ProtocolError(
+            f"frame of {length} bytes exceeds DKTPU_NET_MAX_FRAME={limit}")
+    return kind, crc, length
+
+
+def decode_frame(raw: bytes) -> tuple[int, dict, list[np.ndarray]]:
+    """Verify + decode one whole raw frame: ``(kind, header, arrays)``."""
+    kind, crc, length = parse_prefix(raw[:PREFIX_SIZE],
+                                     max_frame=len(raw))
+    body = raw[PREFIX_SIZE:]
+    if len(body) != length:
+        raise ProtocolError(
+            f"frame declares {length} body bytes, got {len(body)}")
+    if zlib.crc32(body) != crc:
+        raise ProtocolError("frame checksum mismatch (corrupt or truncated)")
+    header, arrays = _decode_body(body)
+    return kind, header, arrays
+
+
+def _decode_body(body: bytes) -> tuple[dict, list[np.ndarray]]:
+    if len(body) < 4:
+        raise ProtocolError(f"frame body too short ({len(body)} bytes)")
+    (hlen,) = struct.unpack_from("!I", body)
+    if 4 + hlen > len(body):
+        raise ProtocolError(
+            f"header length {hlen} exceeds body ({len(body)} bytes)")
+    try:
+        header = json.loads(body[4:4 + hlen].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ProtocolError(f"undecodable frame header: {e}") from e
+    arrays: list[np.ndarray] = []
+    off = 4 + hlen
+    for spec in header.get("arrays", ()):
+        # Every decode error on untrusted header bytes must surface as the
+        # typed ProtocolError (a crafted negative dim would otherwise slip
+        # past the truncation check as a negative byte count and escape as
+        # a raw ValueError from numpy).
+        try:
+            dt = np.dtype(spec["dtype"])
+            shape = tuple(int(s) for s in spec["shape"])
+        except (TypeError, ValueError, KeyError) as e:
+            raise ProtocolError(f"bad array spec {spec!r}: {e}") from e
+        if any(s < 0 for s in shape):
+            raise ProtocolError(f"negative dimension in array spec {spec!r}")
+        count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        n = dt.itemsize * count
+        if off + n > len(body):
+            raise ProtocolError(
+                f"array section truncated: need {n} bytes at offset {off}, "
+                f"body is {len(body)}")
+        try:
+            arrays.append(np.frombuffer(body, dtype=dt, count=count,
+                                        offset=off).reshape(shape).copy())
+        except ValueError as e:
+            raise ProtocolError(f"undecodable array {spec!r}: {e}") from e
+        off += n
+    if off != len(body):
+        raise ProtocolError(
+            f"{len(body) - off} trailing bytes after declared arrays")
+    return header, arrays
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly ``n`` bytes or raise: ``ConnectionError`` on EOF,
+    ``socket.timeout`` per the socket's timeout (the caller's deadline)."""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise ConnectionError(
+                f"connection closed mid-frame ({got}/{n} bytes)")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def finish_raw_frame(sock: socket.socket, prefix: bytes,
+                     max_frame: Optional[int] = None) -> bytes:
+    """Given an already-received prefix, read the body: whole raw frame."""
+    _kind, _crc, length = parse_prefix(prefix, max_frame)
+    return prefix + recv_exact(sock, length)
+
+
+def read_raw_frame(sock: socket.socket,
+                   max_frame: Optional[int] = None) -> bytes:
+    """One whole frame off ``sock`` as raw bytes, prefix checks applied but
+    body neither checksummed nor decoded — the chaos proxy forwards frames
+    opaquely, and *delivering* a corrupt frame is exactly its job."""
+    return finish_raw_frame(sock, recv_exact(sock, PREFIX_SIZE), max_frame)
+
+
+def read_frame(sock: socket.socket, max_frame: Optional[int] = None,
+               ) -> tuple[int, dict, list[np.ndarray]]:
+    """Read + verify + decode one frame: ``(kind, header, arrays)``."""
+    raw = read_raw_frame(sock, max_frame)
+    return decode_frame(raw)
+
+
+def send_frame(sock: socket.socket, kind: int, header: dict,
+               arrays: Sequence[np.ndarray] = ()) -> int:
+    """Encode + send one frame; returns bytes written (telemetry)."""
+    frame = encode_frame(kind, header, arrays)
+    sock.sendall(frame)
+    return len(frame)
+
+
+def split_endpoint(endpoint: str) -> tuple[str, int]:
+    """``"host:port"`` -> (host, port) with a typed error on malformed input."""
+    host, sep, port = endpoint.rpartition(":")
+    if not sep or not host or not port.isdigit():
+        raise ValueError(
+            f"malformed endpoint {endpoint!r}: expected 'host:port'")
+    return host, int(port)

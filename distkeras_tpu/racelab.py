@@ -31,6 +31,9 @@ from typing import Callable, Sequence
 
 import numpy as np
 
+from distkeras_tpu.netps.errors import ServerClosedError
+from distkeras_tpu.netps.fold import check_discipline, fold_delta
+
 
 class RacedParameterServer:
     """The reference's server half: lock + fold, commit-order = thread race.
@@ -40,34 +43,46 @@ class RacedParameterServer:
     'aeasgd'/'eamsgd' (center += elastic difference — the reference routed
     both elastic trainers through the plain ``DeltaParameterServer``; all
     elasticity lives on the worker side, SURVEY.md §3.3).
+
+    The fold itself is :func:`distkeras_tpu.netps.fold.fold_delta` — the
+    SAME function the networked ``PSServer`` applies, so the raced-parity
+    measurements in ``tests/test_raced_ps.py`` cover both transports.
     """
 
     def __init__(self, center: Sequence[np.ndarray], discipline: str = "adag"):
-        if discipline not in ("downpour", "adag", "dynsgd", "aeasgd",
-                              "eamsgd"):
-            raise ValueError(f"unsupported raced discipline {discipline!r}")
+        check_discipline(discipline)
         self._lock = threading.Lock()
         self._center = [np.array(a, np.float32) for a in center]
         self._updates = 0  # server update counter (DynSGD staleness basis)
+        self._closed = False
         self.discipline = discipline
         #: realized staleness of each commit, in commit order (recorded for
         #: EVERY discipline — the race-happened evidence; only dynsgd also
         #: *scales* by it).
         self.commit_log: list[int] = []
 
+    def close(self) -> None:
+        """Shut the server: every subsequent ``pull``/``commit`` raises a
+        typed :class:`ServerClosedError`, so a leaked worker thread exits
+        its loop instead of committing into a dead center forever."""
+        with self._lock:
+            self._closed = True
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise ServerClosedError("RacedParameterServer is closed")
+
     def pull(self) -> tuple[list[np.ndarray], int]:
         with self._lock:
+            self._check_open()
             return [a.copy() for a in self._center], self._updates
 
     def commit(self, delta: Sequence[np.ndarray], pulled_counter: int) -> None:
         with self._lock:
+            self._check_open()
             staleness = self._updates - pulled_counter
             self.commit_log.append(staleness)
-            scale = 1.0
-            if self.discipline == "dynsgd":
-                scale = 1.0 / (staleness + 1.0)
-            for c, d in zip(self._center, delta):
-                c += scale * np.asarray(d, np.float32)
+            fold_delta(self._center, delta, self.discipline, staleness)
             self._updates += 1
 
     def center(self) -> list[np.ndarray]:

@@ -56,6 +56,16 @@ _KINDS = frozenset({
     "nan", "inf", "stall", "feeder_error", "crash", "kill", "ckpt_corrupt",
 })
 
+#: network fault kinds (``DKTPU_NET_FAULTS``), consumed by the netps chaos
+#: proxy (``netps/chaos.py``) and the remote worker loop. ``at`` indexes
+#: client->server *frames* for the wire kinds and commit *rounds* for
+#: ``evict``. The ``_r`` variants hit the reply (server->client) direction
+#: of the same frame index — "per direction" fault injection.
+_NET_KINDS = frozenset({
+    "delay", "drop", "dup", "truncate", "partition", "evict",
+    "delay_r", "drop_r", "dup_r", "truncate_r",
+})
+
 
 class FaultPlan:
     """A seeded, deterministic schedule of injected faults.
@@ -80,8 +90,12 @@ class FaultPlan:
             self._fired = {(k, int(at)) for k, at in self._fired}
 
     @classmethod
-    def parse(cls, spec: str,
-              state_file: Optional[str] = None) -> "FaultPlan":
+    def parse(cls, spec: str, state_file: Optional[str] = None,
+              kinds: Optional[frozenset] = None) -> "FaultPlan":
+        """Parse a ``kind@at[:arg]`` plan. ``kinds`` selects the grammar:
+        the compute kinds (default, ``DKTPU_FAULTS``) or the network kinds
+        (``_NET_KINDS``, ``DKTPU_NET_FAULTS`` via :meth:`parse_net`)."""
+        kinds = _KINDS if kinds is None else kinds
         faults: dict = {}
         seed = 0
         for entry in spec.split(";"):
@@ -93,19 +107,24 @@ class FaultPlan:
                 continue
             if "@" not in entry:
                 raise ValueError(
-                    f"bad DKTPU_FAULTS entry {entry!r}: expected "
+                    f"bad fault entry {entry!r}: expected "
                     "kind@round[:arg] or seed=N")
             kind, at = entry.split("@", 1)
             kind = kind.strip()
-            if kind not in _KINDS:
+            if kind not in kinds:
                 raise ValueError(
-                    f"unknown fault kind {kind!r}; known: {sorted(_KINDS)}")
+                    f"unknown fault kind {kind!r}; known: {sorted(kinds)}")
             arg: Optional[float] = None
             if ":" in at:
                 at, args = at.split(":", 1)
                 arg = float(args)
             faults[(kind, int(at))] = arg
         return cls(faults, seed=seed, state_file=state_file)
+
+    @classmethod
+    def parse_net(cls, spec: str) -> "FaultPlan":
+        """Parse a network-fault plan (``DKTPU_NET_FAULTS`` grammar)."""
+        return cls.parse(spec, kinds=_NET_KINDS)
 
     @classmethod
     def from_env(cls) -> Optional["FaultPlan"]:
@@ -138,6 +157,13 @@ class FaultPlan:
         return arg if arg is not None else 0.0
 
     # -- queries (all one-shot) ----------------------------------------
+    def fire(self, kind: str, at: int) -> Optional[float]:
+        """Generic one-shot query: the fault's arg (0.0 when argless) if
+        ``(kind, at)`` is scheduled and unfired, else None. The network
+        kinds go through this — the chaos proxy and the remote worker loop
+        ask by (kind, frame/round index) directly."""
+        return self._fire(kind, at)
+
     def batch_fault(self, round_idx: int) -> Optional[str]:
         """``"nan"``/``"inf"`` if this round's batch should be poisoned."""
         for kind in ("nan", "inf"):
@@ -186,6 +212,10 @@ _CACHED_SPEC: Optional[str] = None
 _CACHED_PLAN: Optional[FaultPlan] = None
 _EXPLICIT: Optional[FaultPlan] = None
 _EXPLICIT_SET = False
+_NET_CACHED_SPEC: Optional[str] = None
+_NET_CACHED_PLAN: Optional[FaultPlan] = None
+_NET_EXPLICIT: Optional[FaultPlan] = None
+_NET_EXPLICIT_SET = False
 
 
 def active_plan() -> Optional[FaultPlan]:
@@ -217,12 +247,44 @@ def set_plan(plan: Optional[FaultPlan]) -> None:
         _EXPLICIT_SET = True
 
 
+def active_net_plan() -> Optional[FaultPlan]:
+    """The process-ambient *network* FaultPlan (``DKTPU_NET_FAULTS``), with
+    the same cache-by-spec one-shot semantics as :func:`active_plan`. The
+    chaos proxy and the netps remote worker loop consult this."""
+    global _NET_CACHED_SPEC, _NET_CACHED_PLAN
+    if _NET_EXPLICIT_SET:
+        return _NET_EXPLICIT
+    spec = config.env_str("DKTPU_NET_FAULTS")
+    if not spec:
+        return None
+    with _LOCK:
+        if spec != _NET_CACHED_SPEC:
+            _NET_CACHED_PLAN = FaultPlan.parse_net(spec)
+            _NET_CACHED_SPEC = spec
+        return _NET_CACHED_PLAN
+
+
+def set_net_plan(plan: Optional[FaultPlan]) -> None:
+    """Install ``plan`` as the ambient network plan (tests)."""
+    global _NET_EXPLICIT, _NET_EXPLICIT_SET
+    with _LOCK:
+        _NET_EXPLICIT = plan
+        _NET_EXPLICIT_SET = True
+
+
 def reset() -> None:
-    """Clear the explicit plan and the env cache (the next
-    :func:`active_plan` re-reads ``DKTPU_FAULTS`` with fresh fired-state)."""
+    """Clear the explicit plans and the env caches (the next
+    :func:`active_plan` / :func:`active_net_plan` re-reads its env var with
+    fresh fired-state)."""
     global _EXPLICIT, _EXPLICIT_SET, _CACHED_SPEC, _CACHED_PLAN
+    global _NET_EXPLICIT, _NET_EXPLICIT_SET
+    global _NET_CACHED_SPEC, _NET_CACHED_PLAN
     with _LOCK:
         _EXPLICIT = None
         _EXPLICIT_SET = False
         _CACHED_SPEC = None
         _CACHED_PLAN = None
+        _NET_EXPLICIT = None
+        _NET_EXPLICIT_SET = False
+        _NET_CACHED_SPEC = None
+        _NET_CACHED_PLAN = None

@@ -28,6 +28,8 @@ import time
 import warnings
 from typing import Tuple, Type
 
+from distkeras_tpu.resilience.backoff import full_jitter
+
 
 class Supervisor:
     """Wrap a trainer's ``train`` in a bounded retry-with-resume loop.
@@ -41,8 +43,12 @@ class Supervisor:
     max_retries:
         Retries *after* the first attempt (3 → up to 4 attempts total).
     backoff_s / max_backoff_s:
-        Exponential retry delay: ``backoff_s * 2**(attempt-1)``, capped.
-        Pass ``backoff_s=0`` for immediate retries (tests).
+        Exponential retry envelope: each retry sleeps a **full-jitter**
+        draw from ``[0, min(max_backoff_s, backoff_s * 2**(attempt-1)))``
+        (:func:`~distkeras_tpu.resilience.backoff.full_jitter` — the same
+        rule the netps client uses), so simultaneously-crashed trainers
+        don't retry in lockstep. Pass ``backoff_s=0`` for immediate
+        retries (tests).
     retry_on:
         Exception types worth retrying. Defaults to ``Exception`` —
         ``KeyboardInterrupt``/``SystemExit`` always propagate.
@@ -98,8 +104,8 @@ class Supervisor:
                         stacklevel=2)
                     if self.trainer.checkpoint_dir:
                         self.trainer.resume = True
-                    delay = min(self.backoff_s * (2 ** retries),
-                                self.max_backoff_s)
+                    delay = full_jitter(self.backoff_s, retries,
+                                        self.max_backoff_s)
                     if delay > 0:
                         time.sleep(delay)
 

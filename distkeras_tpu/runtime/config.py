@@ -73,7 +73,7 @@ class EnvVar:
     kind: str
     default: object
     doc: str
-    category: str  # "observability" | "resilience" | "data" | "interop"
+    category: str  # "observability" | "resilience" | "network" | "data" | "interop"
 
 
 def _declare(*vars_: EnvVar) -> dict:
@@ -125,6 +125,42 @@ ENV_REGISTRY: dict = _declare(
            "`kill@R`) survive the process restart they cause. Empty = "
            "in-memory only.",
            "resilience"),
+    EnvVar("DKTPU_NET_TIMEOUT", "float", 30.0,
+           "Per-attempt RPC deadline (seconds) for every netps network "
+           "operation: connect, send, and the full reply all fit inside it.",
+           "network"),
+    EnvVar("DKTPU_NET_RETRIES", "int", 5,
+           "Retries after the first attempt for a retryable netps RPC "
+           "failure (timeout, connection loss, framing error); the typed "
+           "rejections (draining, lease expired) never retry.",
+           "network"),
+    EnvVar("DKTPU_NET_BACKOFF", "float", 0.05,
+           "Base of the netps retry backoff: each retry sleeps a "
+           "full-jitter draw from [0, base * 2^attempt), capped — "
+           "decorrelated, so a partition's W victims don't retry in "
+           "lockstep.",
+           "network"),
+    EnvVar("DKTPU_NET_MAX_FRAME", "int", 1 << 30,
+           "Largest wire frame (bytes) either netps side will accept; "
+           "oversized frames are rejected before any allocation.",
+           "network"),
+    EnvVar("DKTPU_NET_FAULTS", "str", "",
+           "Network-fault chaos plan for the netps proxy and remote worker "
+           "loop: `kind@frame[:arg]` entries (`delay`/`drop`/`dup`/"
+           "`truncate`/`partition`/`evict`, `_r` suffix = reply direction) "
+           "separated by `;`, e.g. `delay@3:0.2;drop@5;partition@7:2`. "
+           "Empty = no injection. See docs/RESILIENCE.md.",
+           "network"),
+    EnvVar("DKTPU_PS_LEASE", "float", 10.0,
+           "Membership lease (seconds) the netps server grants on join and "
+           "renews on every pull/commit/heartbeat; a worker silent past it "
+           "is evicted and training continues with the survivors.",
+           "network"),
+    EnvVar("DKTPU_PS_ENDPOINT", "str", "",
+           "`host:port` of a running netps parameter server; async "
+           "trainers use it when `remote=` is not passed explicitly "
+           "(`Job` sets it for every launched worker).",
+           "network"),
     EnvVar("DKTPU_NO_NATIVE", "bool", False,
            "`1` disables the native (C++) data-plane kernels; every gather "
            "falls back to numpy (bit-identical, slower).",

@@ -40,8 +40,28 @@ from distkeras_tpu.parallel.disciplines import (
 )
 from distkeras_tpu.parallel.engine import AsyncEngine
 from distkeras_tpu.parallel.sync import SyncEngine
+from distkeras_tpu.runtime import config as runtime_config
 from distkeras_tpu.runtime.config import RunConfig
 from distkeras_tpu.runtime.mesh import data_mesh
+
+#: Discipline-fold class -> the wire name the netps server folds under
+#: (subclass before base: EAMSGDFold is an AEASGDFold).
+_FOLD_WIRE_NAMES = (
+    (EAMSGDFold, "eamsgd"),
+    (AEASGDFold, "aeasgd"),
+    (DynSGDFold, "dynsgd"),
+    (ADAGFold, "adag"),
+    (DownpourFold, "downpour"),
+)
+
+
+def _fold_wire_name(disc: Discipline) -> str:
+    for cls, name in _FOLD_WIRE_NAMES:
+        if isinstance(disc, cls):
+            return name
+    raise ValueError(
+        f"{type(disc).__name__} has no networked parameter-server "
+        "equivalent (only the communicating PS disciplines do)")
 
 #: Socket-era reference kwargs that have no TPU meaning: the parameter-server
 #: transport is XLA collectives, so there is no master address/port to bind.
@@ -584,9 +604,20 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
 
     def __init__(self, *args, communication_window: int = 5,
                  parallel: Optional[dict] = None, rules=None,
-                 divergence_reset: Optional[float] = None, **kwargs):
+                 divergence_reset: Optional[float] = None,
+                 remote: Optional[str] = None, **kwargs):
         super().__init__(*args, **kwargs)
         self.config = self.config.replace(communication_window=communication_window)
+        #: ``"host:port"`` of a networked parameter server (netps): the
+        #: worker loop becomes pull -> K local steps -> commit through the
+        #: hardened TCP client instead of the in-process collective fold.
+        #: Defaults from DKTPU_PS_ENDPOINT (set by Job for launched pods).
+        self.remote = remote
+        if remote and parallel:
+            raise ValueError(
+                "remote= (networked parameter server) and parallel= "
+                "(model-parallel submeshes) cannot combine: the remote "
+                "worker loop runs whole-model replicas")
         #: resilience: |worker loss − mean| beyond this threshold re-adopts
         #: the center for that worker (fresh optimizer, reference PS-pull
         #: semantics). None (default) = off; fetches the loss every round
@@ -677,8 +708,61 @@ class AsynchronousDistributedTrainer(DistributedTrainer):
         )
         return self._execute(engine, plan)
 
+    def _remote_endpoint(self) -> Optional[str]:
+        return self.remote or runtime_config.env_str("DKTPU_PS_ENDPOINT") or None
+
+    def _train_remote(self, dataframe: DataFrame, shuffle: bool,
+                      endpoint: str) -> Model:
+        """The networked-PS path: N worker threads, each pull -> K jitted
+        local steps -> commit over TCP through the hardened client
+        (``netps/remote.py``); returns the server's final center."""
+        from distkeras_tpu.netps.remote import run_remote
+        from distkeras_tpu.ops.losses import get_loss
+        from distkeras_tpu.ops.optimizers import get_optimizer
+
+        if self.checkpoint_dir or self.metrics_path:
+            warnings.warn(
+                "remote= training does not drive the checkpoint/metrics "
+                "harness: the parameter-server process owns the center; "
+                "checkpoint_dir/metrics_path are ignored on this path",
+                stacklevel=2)
+        W = self.num_workers or jax.device_count()
+        plan = make_batches(
+            dataframe, self.features_col, self.label_col, self.batch_size,
+            num_workers=W, window=self.communication_window,
+            num_epoch=self.num_epoch, shuffle=shuffle, seed=self.seed,
+            transform=self.transform,
+        )
+        disc = self._discipline()
+        params, losses = run_remote(
+            endpoint=endpoint, model=self.model,
+            tx=get_optimizer(self.worker_optimizer, self.learning_rate),
+            loss_fn=get_loss(self.loss), plan=plan,
+            discipline=_fold_wire_name(disc),
+            window=self.communication_window,
+            alpha=getattr(disc, "alpha", 0.05), seed=self.seed,
+            compute_dtype=self.compute_dtype, grad_accum=self.grad_accum,
+        )
+        self.worker_histories = losses.T
+        self.history = np.nanmean(losses, axis=1)
+        return self.model.with_params(params)
+
     def train(self, dataframe: DataFrame, shuffle: bool = False) -> Model:
         self.record_training_start()
+        endpoint = self._remote_endpoint()
+        if endpoint:
+            # Re-check here, not only in __init__: the endpoint may arrive
+            # via DKTPU_PS_ENDPOINT (a Job-launched pod sets it for every
+            # worker), and silently dropping a requested model-parallel
+            # layout would be far worse than refusing.
+            if self.parallel:
+                raise ValueError(
+                    f"parameter-server endpoint {endpoint!r} (remote= or "
+                    "DKTPU_PS_ENDPOINT) cannot combine with parallel=: the "
+                    "remote worker loop runs whole-model replicas")
+            model = self._train_remote(dataframe, shuffle, endpoint)
+            self.record_training_stop()
+            return model
         state = self._run(dataframe, shuffle)
         self.record_training_stop()
         return self._finish_model(state.center, state, worker=0)

@@ -1,0 +1,98 @@
+"""Environment-capability probes behind the tier-1 skip triage.
+
+Tier-1's contract is *failures mean bugs*. A test that fails because this
+jax/jaxlib/orbax build lacks a capability — not because the code under
+test regressed — poisons that signal, so each such prerequisite is probed
+ONCE here (a concrete reproduction, not a version guess) and the affected
+tests skip with a reason naming exactly what is missing. On an
+environment that has the capability, the probe passes and the tests run —
+nothing is permanently retired.
+
+Probes, and the failure they reproduce:
+
+* ``key_arrays_shardable_with_auto_axes`` — lowering a typed PRNG key
+  array (trailing ``u32[2]``) through ``shard_map`` with a GSPMD ``auto``
+  subgroup axis. jaxlib 0.4.36's SPMD partitioner rejects it ("Number of
+  tile assignment dimensions ... is different than the input rank",
+  ``input_shape=u32[2]``), which kills every AsyncTP/SPMD-engine program
+  (manual data/seq axes + auto model axis).
+* ``xla_combines_all_reduces`` — whether XLA's AllReduceCombiner folds
+  several small psums into one fused all-reduce on this backend. The HLO
+  property tests pin "one fused fold per round"; a build whose combiner
+  is inactive reports one all-reduce *per parameter tensor* and the
+  property is untestable.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+
+import numpy as np
+import pytest
+
+
+@functools.lru_cache(maxsize=None)
+def key_arrays_shardable_with_auto_axes() -> bool:
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from distkeras_tpu.ops.collectives import shard_map
+
+    if jax.device_count() < 4:
+        return False
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("data", "model"))
+
+    def f(k):
+        return jax.random.fold_in(k, jax.lax.axis_index("data"))
+
+    try:
+        jax.block_until_ready(jax.jit(shard_map(
+            f, mesh=mesh, in_specs=P(), out_specs=P(), check_rep=False,
+            auto=frozenset({"model"})))(jax.random.key(0)))
+        return True
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=None)
+def xla_combines_all_reduces() -> bool:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from distkeras_tpu.ops.collectives import shard_map
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+
+    def f(xs):
+        return [jax.lax.psum(x, "data") for x in xs]
+
+    xs = [jnp.ones((4, 4)), jnp.ones((8,)), jnp.ones((2, 2))]
+    try:
+        hlo = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=P(), out_specs=P(),
+            check_rep=False)).lower(xs).compile().as_text()
+    except Exception:
+        return False
+    return len(re.findall(r"all-reduce(?:-start)?\(", hlo)) <= 1
+
+
+def skip_unless_key_sharding():
+    return pytest.mark.skipif(
+        not key_arrays_shardable_with_auto_axes(),
+        reason="missing prerequisite: a jaxlib whose SPMD partitioner can "
+               "shard typed PRNG key arrays (u32[2] trailer) through "
+               "shard_map with a GSPMD `auto` subgroup axis — this build "
+               "rejects the sharding (tile-assignment rank error), so no "
+               "AsyncTP/SPMD-engine program can compile here")
+
+
+def skip_unless_allreduce_combiner():
+    return pytest.mark.skipif(
+        not xla_combines_all_reduces(),
+        reason="missing prerequisite: an XLA build with an active "
+               "AllReduceCombiner on this backend — without it every "
+               "parameter tensor keeps its own all-reduce and the "
+               "one-fused-fold HLO property is untestable")

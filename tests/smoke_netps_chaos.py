@@ -1,0 +1,86 @@
+"""CI netps-chaos smoke (not a pytest module — run directly).
+
+A loopback training run over the **networked parameter server** with
+network faults injected by the chaos proxy: CI invokes it with
+``DKTPU_NET_FAULTS`` scheduling a delay, a drop, one partition, and one
+worker-kill-style eviction (the seeded worker goes silent past its lease
+and must rejoin mid-run), and asserts the run converges and exits 0 —
+the ROADMAP's "heavy traffic on a bad network" story, exercised end to
+end on every PR.
+
+    DKTPU_NET_FAULTS="delay@6:0.2;drop@11;partition@16:0.8;evict@4:2.2;seed=3" \
+        python tests/smoke_netps_chaos.py
+"""
+
+import os
+import sys
+
+# Runs from a checkout without installation: sys.path[0] is tests/, so the
+# repo root must be appended (an installed distkeras_tpu still wins).
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# Tight-but-survivable budgets: a dropped frame must not stall CI for the
+# production 30 s deadline.
+os.environ.setdefault("DKTPU_NET_TIMEOUT", "1.0")
+os.environ.setdefault("DKTPU_NET_RETRIES", "8")
+os.environ.setdefault("DKTPU_NET_BACKOFF", "0.02")
+os.environ.setdefault(
+    "DKTPU_NET_FAULTS",
+    "delay@6:0.2;drop@11;partition@16:0.8;evict@4:2.2;seed=3")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from distkeras_tpu import ADAG, DataFrame, telemetry  # noqa: E402
+from distkeras_tpu.models import Model  # noqa: E402
+from distkeras_tpu.models.mlp import MLP  # noqa: E402
+from distkeras_tpu.netps import ChaosProxy, PSServer  # noqa: E402
+
+
+def main() -> int:
+    rng = np.random.default_rng(0)
+    centers = rng.normal(scale=4.0, size=(3, 4))
+    y = rng.integers(0, 3, size=1024)
+    x = centers[y] + rng.normal(scale=0.5, size=(1024, 4))
+    df = DataFrame({"features": x.astype(np.float32),
+                    "label": y.astype(np.int32)})
+    model = Model.build(MLP(hidden=(16,), num_outputs=3),
+                        jnp.zeros((1, 4), jnp.float32), seed=0)
+    server = PSServer(discipline="adag", lease_s=1.0).start()
+    proxy = ChaosProxy(server.endpoint).start()  # ambient DKTPU_NET_FAULTS
+    try:
+        trainer = ADAG(model, loss="sparse_categorical_crossentropy",
+                       num_workers=4, batch_size=16, num_epoch=3,
+                       learning_rate=0.1, communication_window=4,
+                       remote=proxy.endpoint)
+        trained = trainer.train(df, shuffle=True)
+    finally:
+        proxy.close()
+        server.close()
+    acc = float((np.asarray(trained.predict(jnp.asarray(
+        df["features"]))).argmax(-1) == df["label"]).mean())
+    reg = telemetry.get()
+    retries = reg.counter("netps.retries").value
+    injected = reg.counter("resilience.faults_injected").value
+    print(f"netps chaos run: acc={acc:.4f} commits={len(server.commit_log)} "
+          f"evictions={server.evictions} rejoins={server.rejoins} "
+          f"client_retries={retries:.0f} faults_injected={injected:.0f}")
+    assert acc > 0.85, f"accuracy collapsed under network chaos: {acc}"
+    assert server.evictions >= 1, "the worker-kill eviction never happened"
+    assert server.rejoins >= 1, "the evicted worker never re-joined"
+    assert retries >= 1, "no RPC ever retried — chaos did not bite"
+    seen = set()
+    for wid, seq, _st in server.commit_log:
+        assert (wid, seq) not in seen, f"commit ({wid}, {seq}) folded twice"
+        seen.add((wid, seq))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

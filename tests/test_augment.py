@@ -178,4 +178,8 @@ def test_uint8_features_normalized_in_graph():
     np.testing.assert_allclose(np.asarray(loss_a), np.asarray(loss_b),
                                rtol=1e-6)
     for a, b in zip(jax.tree.leaves(p_a), jax.tree.leaves(p_b)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+        # atol floors the comparison for near-zero weights: the in-graph
+        # x/255 and the precomputed float batch take different fusion paths,
+        # so single-ulp (~1e-9) wobble on ~1e-4 params is expected.
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6,
+                                   atol=1e-8)

@@ -1,0 +1,641 @@
+"""The networked parameter server: wire hardening, exactly-once commits,
+lease-based elastic membership, graceful drain, and network-fault chaos.
+
+The fast tests drive every guarded edge deterministically through the
+in-process :class:`ChaosProxy`; the slow chaos-parity test trains the same
+model/data through netps-over-loopback under injected network faults and
+through the in-process raced PS, asserting final-accuracy parity at the
+``test_raced_ps.py`` tolerance — the fold is literally the same function
+(``netps/fold.py``), so the parity claim transfers transport-for-transport.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.netps import (
+    ChaosProxy,
+    PSClient,
+    PSServer,
+    ProtocolError,
+    RPCTimeoutError,
+    ServerClosedError,
+    ServerDrainingError,
+    commit_scale,
+    fold_delta,
+)
+from distkeras_tpu.netps import wire
+from distkeras_tpu.resilience.faults import FaultPlan
+
+FAST = dict(timeout=1.0, retries=3, backoff=0.01)
+
+
+def make_server(**kw):
+    kw.setdefault("discipline", "adag")
+    return PSServer(**kw).start()
+
+
+def leaves(*shapes):
+    rng = np.random.default_rng(0)
+    return [rng.normal(size=s).astype(np.float32) for s in shapes]
+
+
+# ---------------------------------------------------------------------------
+# Wire protocol hardening
+# ---------------------------------------------------------------------------
+
+def test_wire_roundtrip_header_and_arrays():
+    arrays = [np.arange(6, dtype=np.float32).reshape(2, 3),
+              np.array(7, dtype=np.int64)]  # 0-d array too
+    raw = wire.encode_frame(wire.KIND_REQUEST, {"op": "pull", "req": 3},
+                            arrays)
+    kind, header, out = wire.decode_frame(raw)
+    assert kind == wire.KIND_REQUEST
+    assert header["op"] == "pull" and header["req"] == 3
+    np.testing.assert_array_equal(out[0], arrays[0])
+    assert out[1] == 7
+
+
+def test_wire_rejects_bad_magic_version_and_oversize():
+    raw = wire.encode_frame(wire.KIND_REPLY, {"ok": True}, [])
+    with pytest.raises(ProtocolError, match="magic"):
+        wire.decode_frame(b"XX" + raw[2:])
+    with pytest.raises(ProtocolError, match="version"):
+        wire.decode_frame(raw[:2] + b"\x7f" + raw[3:])
+    big = wire.encode_frame(wire.KIND_REPLY, {},
+                            [np.zeros(1024, np.float32)])
+    with pytest.raises(ProtocolError, match="exceeds"):
+        wire.parse_prefix(big[:wire.PREFIX_SIZE], max_frame=64)
+
+
+def test_wire_checksum_catches_corruption_and_truncation():
+    raw = bytearray(wire.encode_frame(
+        wire.KIND_REPLY, {"ok": True}, [np.ones(8, np.float32)]))
+    raw[-2] ^= 0xFF  # bit-flip inside an array buffer
+    with pytest.raises(ProtocolError, match="checksum"):
+        wire.decode_frame(bytes(raw))
+    whole = wire.encode_frame(wire.KIND_REPLY, {"ok": True},
+                              [np.ones(8, np.float32)])
+    with pytest.raises(ProtocolError):
+        wire.decode_frame(whole[: len(whole) // 2])
+
+
+def test_fold_is_shared_between_raced_and_networked_ps():
+    """One fold function, two transports: the raced-parity evidence
+    transfers because there is literally nothing transport-specific left
+    to diverge."""
+    import distkeras_tpu.racelab as racelab
+    from distkeras_tpu.netps import fold as netfold
+
+    assert racelab.fold_delta is netfold.fold_delta
+    assert commit_scale("dynsgd", 3) == pytest.approx(0.25)
+    assert commit_scale("adag", 3) == 1.0
+    center = [np.zeros(4, np.float32)]
+    fold_delta(center, [np.full(4, 2.0, np.float32)], "dynsgd", staleness=1)
+    np.testing.assert_allclose(center[0], 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Server + client happy path
+# ---------------------------------------------------------------------------
+
+def test_join_pull_commit_heartbeat_leave_roundtrip():
+    srv = make_server()
+    try:
+        with PSClient(srv.endpoint, worker_id=0, **FAST) as c:
+            init = leaves((3, 2), (4,))
+            center, upd = c.join(init=init)
+            assert upd == 0
+            for a, b in zip(center, init):
+                np.testing.assert_array_equal(a, b)
+            res = c.commit([np.ones_like(a) for a in init], upd)
+            assert res.applied and not res.duplicate and not res.evicted
+            assert res.staleness == 0
+            center2, upd2 = c.pull()
+            assert upd2 == 1
+            np.testing.assert_allclose(center2[0], init[0] + 1.0)
+            assert c.heartbeat() == 1
+            c.leave()
+        assert srv.commit_log == [(0, 0, 0)]
+    finally:
+        srv.close()
+
+
+def test_second_join_adopts_existing_center_and_assigns_ids():
+    srv = make_server()
+    try:
+        with PSClient(srv.endpoint, worker_id=0, **FAST) as c0:
+            init = leaves((4,))
+            c0.join(init=init)
+            with PSClient(srv.endpoint, **FAST) as c1:  # no worker_id
+                other = [np.full(4, 9.0, np.float32)]
+                center, _upd = c1.join(init=other)  # late init is ignored
+                assert c1.worker_id == 1
+                np.testing.assert_array_equal(center[0], init[0])
+        # Closing a socket is not leaving: membership is by lease, not by
+        # connection, so both ids are still members until their leases lapse.
+        assert srv.members() == [0, 1]
+    finally:
+        srv.close()
+
+
+def test_join_without_init_on_empty_server_is_typed_error():
+    srv = make_server()
+    try:
+        with PSClient(srv.endpoint, worker_id=0, **FAST) as c:
+            with pytest.raises(Exception, match="uninitialized"):
+                c.join()
+    finally:
+        srv.close()
+
+
+def test_staleness_matches_counter_semantics():
+    """DynSGD's staleness = server updates since the committer's pull —
+    exactly the counter rule the raced twin records."""
+    srv = make_server(discipline="dynsgd")
+    try:
+        with PSClient(srv.endpoint, worker_id=0, **FAST) as a, \
+                PSClient(srv.endpoint, worker_id=1, **FAST) as b:
+            init = [np.zeros(2, np.float32)]
+            _, upd_a = a.join(init=init)
+            _, upd_b = b.join()
+            res_a = a.commit([np.ones(2, np.float32)], upd_a)
+            assert res_a.staleness == 0
+            # b pulled at 0 but commits after a's fold landed: staleness 1,
+            # so DynSGD folds it at 1/2.
+            res_b = b.commit([np.ones(2, np.float32)], upd_b)
+            assert res_b.staleness == 1
+            center, _ = a.pull()
+            np.testing.assert_allclose(center[0], 1.0 + 0.5)
+        assert [s for (_w, _q, s) in srv.commit_log] == [0, 1]
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Chaos: every fault kind, per direction
+# ---------------------------------------------------------------------------
+
+def chaos_pair(spec, discipline="downpour", lease_s=None, **client_kw):
+    srv = PSServer(discipline=discipline, lease_s=lease_s).start()
+    px = ChaosProxy(srv.endpoint, plan=FaultPlan.parse_net(spec)).start()
+    kw = dict(FAST)
+    kw.update(client_kw)
+    return srv, px, PSClient(px.endpoint, worker_id=0, **kw)
+
+
+def test_retried_commit_after_dropped_ack_folds_exactly_once():
+    """THE exactly-once scenario: the server applies the commit, the ACK is
+    lost (chaos ``drop_r``), the client times out and retransmits with the
+    SAME seq, the server answers duplicate — one fold in the commit log."""
+    # frame 0 = join; frame 1 = the commit whose reply is dropped.
+    srv, px, c = chaos_pair("drop_r@1", timeout=0.3, retries=4)
+    try:
+        _, upd = c.join(init=[np.zeros(3, np.float32)])
+        res = c.commit([np.ones(3, np.float32)], upd)
+        assert res.duplicate and not res.applied  # answered by the dedup
+        assert srv.commit_log == [(0, 0, 0)], srv.commit_log
+        np.testing.assert_allclose(srv.center()[0], 1.0)  # folded ONCE
+    finally:
+        c.close()
+        px.close()
+        srv.close()
+
+
+def test_duplicated_commit_frame_is_deduped_and_stream_stays_sane():
+    srv, px, c = chaos_pair("dup@1")
+    try:
+        _, upd = c.join(init=[np.zeros(3, np.float32)])
+        res = c.commit([np.ones(3, np.float32)], upd)  # delivered twice
+        assert res.applied
+        assert srv.commit_log == [(0, 0, 0)]
+        np.testing.assert_allclose(srv.center()[0], 1.0)
+        # The duplicate's reply is still in flight/buffered: the req-id echo
+        # must keep the next RPC correctly matched.
+        center, upd2 = c.pull()
+        assert upd2 == 1
+        np.testing.assert_allclose(center[0], 1.0)
+    finally:
+        c.close()
+        px.close()
+        srv.close()
+
+
+def test_truncate_delay_and_drop_are_survived_by_retry():
+    spec = "truncate@1;delay@2:0.05;drop@3"
+    srv, px, c = chaos_pair(spec, timeout=0.3, retries=5)
+    try:
+        _, upd = c.join(init=[np.zeros(3, np.float32)])
+        res = c.commit([np.ones(3, np.float32)], upd)  # truncated, retried
+        assert res.applied or res.duplicate
+        c.pull()       # delayed 50ms, inside the deadline
+        c.pull()       # dropped, then retried
+        assert len(srv.commit_log) == 1  # chaos never double-folded
+    finally:
+        c.close()
+        px.close()
+        srv.close()
+
+
+def test_partition_is_ridden_out_by_jittered_retries():
+    srv, px, c = chaos_pair("partition@1:0.5", timeout=0.3, retries=10,
+                            backoff=0.05)
+    try:
+        _, upd = c.join(init=[np.zeros(3, np.float32)])
+        t0 = time.monotonic()
+        center, _ = c.pull()  # triggers the partition, retries through it
+        assert time.monotonic() - t0 > 0.3
+        np.testing.assert_array_equal(center[0], np.zeros(3))
+    finally:
+        c.close()
+        px.close()
+        srv.close()
+
+
+def test_retry_budget_is_bounded():
+    """A dead endpoint exhausts the budget and raises the typed error with
+    the attempt count — it does not retry forever."""
+    sock = socket.create_server(("127.0.0.1", 0))  # accepts, never answers
+    port = sock.getsockname()[1]
+    try:
+        c = PSClient(f"127.0.0.1:{port}", worker_id=0, timeout=0.1,
+                     retries=2, backoff=0.01)
+        with pytest.raises(RPCTimeoutError) as ei:
+            c.pull()
+        assert ei.value.attempts == 3
+        c.close()
+    finally:
+        sock.close()
+
+
+# ---------------------------------------------------------------------------
+# Leases, eviction, rejoin, drain
+# ---------------------------------------------------------------------------
+
+def test_lease_eviction_and_mid_run_rejoin():
+    srv = make_server(lease_s=0.3)
+    try:
+        c = PSClient(srv.endpoint, worker_id=0, **FAST)
+        _, upd = c.join(init=[np.zeros(3, np.float32)])
+        res = c.commit([np.ones(3, np.float32)], upd)
+        assert res.applied
+        deadline = time.monotonic() + 5.0
+        while srv.members() and time.monotonic() < deadline:
+            time.sleep(0.05)  # monitor evicts once the lease lapses
+        assert srv.members() == []
+        assert srv.evictions == 1
+        # The next pull transparently re-joins and returns the live center.
+        center, _upd = c.pull()
+        assert c.rejoin_count == 1 and srv.rejoins == 1
+        assert srv.members() == [0]
+        np.testing.assert_allclose(center[0], 1.0)
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_evicted_commit_is_discarded_and_reports_evicted():
+    srv = make_server(lease_s=0.3)
+    try:
+        c = PSClient(srv.endpoint, worker_id=0, **FAST)
+        _, upd = c.join(init=[np.zeros(3, np.float32)])
+        deadline = time.monotonic() + 5.0
+        while srv.members() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        res = c.commit([np.ones(3, np.float32)], upd)
+        assert res.evicted and not res.applied
+        assert srv.commit_log == []          # the stale window was discarded
+        assert srv.members() == [0]          # ...and the client re-joined
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_pre_eviction_retransmit_still_deduped_after_rejoin():
+    """last_seq survives eviction: a commit applied just before the lease
+    lapsed cannot re-fold when its retransmit arrives after the rejoin."""
+    srv = make_server(lease_s=0.3)
+    try:
+        c = PSClient(srv.endpoint, worker_id=0, **FAST)
+        _, upd = c.join(init=[np.zeros(3, np.float32)])
+        res = c.commit([np.ones(3, np.float32)], upd)
+        assert res.applied
+        deadline = time.monotonic() + 5.0
+        while srv.members() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        c.pull()  # rejoin
+        # Hand-craft the retransmit of seq 0 (the client normally only does
+        # this inside one commit's retry loop).
+        hdr, _ = c._rpc("commit", {"seq": 0, "pulled": 0},
+                        [np.ones(3, np.float32)])
+        assert hdr["duplicate"] is True
+        assert srv.commit_log == [(0, 0, 0)]
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_restarted_worker_resumes_commit_sequence():
+    """A restarted worker process (fresh client, seq counter back at -1,
+    same worker_id — the Job.supervise restart scenario) must keep
+    contributing: join hands back the server's last folded seq so the new
+    incarnation's commits are not deduped away as retransmits."""
+    srv = make_server()
+    try:
+        with PSClient(srv.endpoint, worker_id=0, **FAST) as c1:
+            _, upd = c1.join(init=[np.zeros(3, np.float32)])
+            for _ in range(3):
+                _, upd = c1.pull()
+                assert c1.commit([np.ones(3, np.float32)], upd).applied
+        # "Host restart": a brand-new client claims the same worker_id.
+        with PSClient(srv.endpoint, worker_id=0, **FAST) as c2:
+            _, upd = c2.join()
+            res = c2.commit([np.ones(3, np.float32)], upd)
+            assert res.applied and not res.duplicate, res
+        assert [seq for (_w, seq, _s) in srv.commit_log] == [0, 1, 2, 3]
+        np.testing.assert_allclose(srv.center()[0], 4.0)
+    finally:
+        srv.close()
+
+
+def test_wire_rejects_malformed_array_specs_as_protocol_errors():
+    """Untrusted header bytes can only fail typed: negative dims and junk
+    dtypes must become ProtocolError, never a raw numpy ValueError that
+    would kill a handler thread outside the typed taxonomy."""
+    import json
+    import struct
+    import zlib
+
+    def frame_with_spec(spec):
+        hjson = json.dumps({"op": "x", "arrays": [spec]}).encode()
+        body = struct.pack("!I", len(hjson)) + hjson + b"\0" * 16
+        return (wire.MAGIC + bytes([wire.VERSION, wire.KIND_REQUEST])
+                + struct.pack("!II", zlib.crc32(body), len(body)) + body)
+
+    with pytest.raises(ProtocolError, match="negative"):
+        wire.decode_frame(frame_with_spec({"dtype": "<f4", "shape": [-4]}))
+    with pytest.raises(ProtocolError, match="bad array spec"):
+        wire.decode_frame(frame_with_spec({"dtype": "not-a-dtype",
+                                           "shape": [2]}))
+    with pytest.raises(ProtocolError, match="bad array spec"):
+        wire.decode_frame(frame_with_spec({"dtype": "<f4"}))  # no shape
+
+
+def test_drain_rejects_commits_typed_but_serves_final_pull():
+    srv = make_server()
+    c = PSClient(srv.endpoint, worker_id=0, **FAST)
+    try:
+        _, upd = c.join(init=[np.zeros(3, np.float32)])
+        c.commit([np.ones(3, np.float32)], upd)
+        srv.drain()
+        with pytest.raises(ServerDrainingError):
+            c.commit([np.ones(3, np.float32)], upd)
+        center, _ = c.pull()  # departing workers may fetch the final center
+        np.testing.assert_allclose(center[0], 1.0)
+        with pytest.raises(ServerDrainingError):
+            PSClient(srv.endpoint, worker_id=9, **FAST).join(
+                init=[np.zeros(3, np.float32)])
+    finally:
+        c.close()
+        srv.close()
+
+
+def test_close_joins_every_server_thread():
+    before = {t.name for t in threading.enumerate()}
+    srv = make_server()
+    with PSClient(srv.endpoint, worker_id=0, **FAST) as c:
+        c.join(init=[np.zeros(2, np.float32)])
+        assert any(t.name.startswith("netps-")
+                   for t in threading.enumerate())
+    srv.close()
+    after = {t.name for t in threading.enumerate()}
+    lingering = [n for n in after - before if n.startswith("netps-")]
+    assert not lingering, lingering
+
+
+def test_client_use_after_close_is_typed():
+    srv = make_server()
+    try:
+        c = PSClient(srv.endpoint, worker_id=0, **FAST)
+        c.join(init=[np.zeros(2, np.float32)])
+        c.close()
+        with pytest.raises(ServerClosedError):
+            c.pull()
+    finally:
+        srv.close()
+
+
+def test_rpc_telemetry_spans_and_counters_recorded():
+    from distkeras_tpu import telemetry
+
+    telemetry.reset()
+    srv = make_server()
+    try:
+        with PSClient(srv.endpoint, worker_id=0, **FAST) as c:
+            _, upd = c.join(init=[np.zeros(2, np.float32)])
+            c.commit([np.ones(2, np.float32)], upd)
+            c.pull()
+        snap = telemetry.get().snapshot()
+        assert snap["spans"]["netps.rpc.commit"]["count"] == 1
+        assert snap["spans"]["netps.server.pull"]["count"] >= 1
+        assert snap["counters"]["netps.commits"] == 1
+        assert snap["counters"]["netps.bytes_sent"] > 0
+        assert snap["counters"]["netps.bytes_received"] > 0
+    finally:
+        srv.close()
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# Lock discipline: the witness over genuinely racing handler threads
+# ---------------------------------------------------------------------------
+
+def test_server_handler_threads_under_lock_witness():
+    """The runtime lock-order witness over the server's per-connection
+    handler threads (plus the lease monitor): no inversion across racing
+    commits, and every witnessed edge involving netps locks exists in the
+    static DK201 graph."""
+    import os
+
+    import distkeras_tpu
+    from distkeras_tpu.analysis import core, witness
+    from distkeras_tpu.analysis.rules_concurrency import build_lock_graph
+
+    with witness() as w:
+        srv = make_server(lease_s=5.0)
+        errors = []
+
+        def worker(wid):
+            try:
+                c = PSClient(srv.endpoint, worker_id=wid, **FAST)
+                _, upd = c.join(init=[np.zeros(8, np.float32)])
+                for _ in range(5):
+                    center, upd = c.pull()
+                    c.commit([np.ones(8, np.float32)], upd)
+                c.leave()
+                c.close()
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        srv.close()
+    assert not errors, errors
+    assert len(srv.commit_log) == 20
+    w.assert_no_inversions()
+    pkg = os.path.dirname(os.path.abspath(distkeras_tpu.__file__))
+    modules, _ = core.parse_modules([pkg])
+    static_edges, _, _ = build_lock_graph(modules)
+    netps_edges = {e for e in w.edges()
+                   if "server.PSServer" in e[0] or "server.PSServer" in e[1]}
+    assert netps_edges <= static_edges, netps_edges - static_edges
+
+
+# ---------------------------------------------------------------------------
+# Remote training: trainers over the wire
+# ---------------------------------------------------------------------------
+
+def _blob_data(seed=0, n=512, dim=4, classes=3):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=4.0, size=(classes, dim))
+    y = rng.integers(0, classes, size=n)
+    x = (centers[y] + rng.normal(scale=0.5, size=(n, dim))).astype(np.float32)
+    return x, y.astype(np.int32)
+
+
+def _mlp_model(seed=0, dim=4, classes=3):
+    from distkeras_tpu.models import Model
+    from distkeras_tpu.models.mlp import MLP
+
+    return Model.build(MLP(hidden=(16,), num_outputs=classes),
+                       np.zeros((1, dim), np.float32), seed=seed)
+
+
+def _acc(model, x, y):
+    return float((np.asarray(model.predict(x)).argmax(-1) == y).mean())
+
+
+def test_remote_trainer_trains_over_loopback(monkeypatch):
+    """`remote="host:port"` on an async trainer: the worker loop runs
+    pull -> K jitted local steps -> commit through the hardened client,
+    and the final model is the server's center."""
+    from distkeras_tpu import ADAG
+
+    monkeypatch.setenv("DKTPU_NET_TIMEOUT", "2.0")
+    x, y = _blob_data()
+    from distkeras_tpu import DataFrame
+
+    df = DataFrame({"features": x, "label": y})
+    srv = make_server()
+    try:
+        t = ADAG(_mlp_model(), loss="sparse_categorical_crossentropy",
+                 num_workers=2, batch_size=16, num_epoch=2,
+                 learning_rate=0.1, communication_window=4,
+                 remote=srv.endpoint)
+        trained = t.train(df, shuffle=True)
+        assert _acc(trained, x, y) > 0.85
+        assert len(srv.commit_log) > 0
+        assert t.get_history() is not None
+        assert t.get_worker_histories().shape[0] == 2
+    finally:
+        srv.close()
+
+
+def test_remote_endpoint_from_env_and_parallel_conflict(monkeypatch):
+    from distkeras_tpu import ADAG
+
+    t = ADAG(_mlp_model(), num_workers=2)
+    assert t._remote_endpoint() is None
+    monkeypatch.setenv("DKTPU_PS_ENDPOINT", "ps-host:7077")
+    assert t._remote_endpoint() == "ps-host:7077"
+    with pytest.raises(ValueError, match="remote"):
+        ADAG(_mlp_model(), remote="h:1", parallel={"model": 2})
+
+
+def test_punchcard_ps_launch_rendering():
+    """Job/Punchcard learn the PS: a `ps` field renders the server launch
+    line and threads the endpoint to every worker via DKTPU_PS_ENDPOINT."""
+    from distkeras_tpu.job_deployment import Job, Punchcard
+
+    pc = Punchcard(job_name="j", script="train.py",
+                   hosts=["10.0.0.1", "10.0.0.2"],
+                   ps={"discipline": "dynsgd", "port": 7171, "lease": 5.0})
+    assert pc.ps_endpoint() == "10.0.0.1:7171"
+    job = Job(pc)
+    ps_cmd = job.render_ps_command()
+    assert "python -m distkeras_tpu.netps" in ps_cmd
+    assert "--discipline dynsgd" in ps_cmd and "--port 7171" in ps_cmd
+    assert "--lease 5.0" in ps_cmd
+    for cmd in job.launch(dry_run=True):
+        assert "DKTPU_PS_ENDPOINT=10.0.0.1:7171" in cmd
+    # JSON round-trip keeps the ps block (the punchcard is the job card).
+    assert Punchcard.from_json(pc.to_json()).ps == pc.ps
+    # No ps: nothing rendered, no endpoint injected.
+    bare = Job(Punchcard(job_name="j", script="s.py", hosts=["h"]))
+    assert bare.render_ps_command() is None
+    assert "DKTPU_PS_ENDPOINT" not in bare.launch(dry_run=True)[0]
+
+
+@pytest.mark.slow
+def test_netps_chaos_parity_with_raced_ps(monkeypatch):
+    """THE acceptance scenario: the same model/data trained (a) through
+    netps over loopback with chaos injecting delay/drop/duplicate, a lost
+    commit ACK, and one mid-run worker eviction + rejoin, and (b) through
+    the in-process raced PS — final accuracies agree at the raced-parity
+    tolerance, and the lost-ACK retransmit folded exactly once."""
+    import test_raced_ps as rp
+    from distkeras_tpu import ADAG, DataFrame
+    from distkeras_tpu.resilience import faults
+
+    monkeypatch.setenv("DKTPU_NET_TIMEOUT", "1.0")
+    monkeypatch.setenv("DKTPU_NET_RETRIES", "8")
+    monkeypatch.setenv("DKTPU_NET_BACKOFF", "0.02")
+    raced_accs, net_accs = [], []
+    for seed in (0, 1):
+        acc_r, _ = rp._raced_accuracy(seed, "adag")
+        raced_accs.append(acc_r)
+        srv = PSServer(discipline="adag", lease_s=1.0).start()
+        # One ambient plan (DKTPU_NET_FAULTS) drives BOTH consumers: the
+        # proxy takes the wire kinds, the remote worker loop takes `evict`.
+        # Frames: 0..W-1 are joins; commits/pulls interleave after. The
+        # indices land on whatever RPC is in flight — chaos does not need
+        # to aim, it needs to be survived. evict@4 puts one seeded worker
+        # to sleep past its lease mid-run (the worker-kill analogue),
+        # drop_r@9 is a lost ACK (commit or pull — either must be safe).
+        faults.reset()  # fresh one-shot state per seed
+        monkeypatch.setenv(
+            "DKTPU_NET_FAULTS",
+            "delay@6:0.1;drop@11;dup@14;drop_r@9;evict@4:2.2;seed=3")
+        px = ChaosProxy(srv.endpoint).start()
+        try:
+            x, y = rp._blobs(seed)
+            df = DataFrame({"features": x, "label": y})
+            t = rp._TRAINERS["adag"](rp._model(seed))
+            t.remote = px.endpoint
+            trained = t.train(df, shuffle=True)
+            net_accs.append(rp._accuracy(trained.predict, x, y))
+            assert srv.evictions >= 1, "eviction chaos never fired"
+            assert srv.rejoins >= 1, "evicted worker never re-joined"
+            # Exactly-once under chaos: seqs folded at most once per worker.
+            seen = set()
+            for wid, seq, _st in srv.commit_log:
+                assert (wid, seq) not in seen, (
+                    f"commit ({wid}, {seq}) folded twice")
+                seen.add((wid, seq))
+        finally:
+            px.close()
+            srv.close()
+            faults.reset()
+    raced_accs, net_accs = np.asarray(raced_accs), np.asarray(net_accs)
+    assert (raced_accs > 0.85).all(), raced_accs
+    assert (net_accs > 0.85).all(), (
+        f"chaos netps run failed to converge: {net_accs}")
+    assert abs(raced_accs.mean() - net_accs.mean()) < 0.05, (
+        raced_accs, net_accs)

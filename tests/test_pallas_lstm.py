@@ -48,8 +48,9 @@ def test_gradients_match_flax(ref_setup):
     gp_pal, gx_pal = jax.grad(loss_pal, argnums=(0, 1))(variables["params"], x)
     np.testing.assert_allclose(np.asarray(gx_pal), np.asarray(gx_ref),
                                rtol=1e-4, atol=1e-5)
-    flat_ref = jax.tree.leaves_with_path(gp_ref)
-    flat_pal = dict(jax.tree.leaves_with_path(gp_pal))
+    # tree_util spelling: jax.tree.leaves_with_path only exists on newer jax.
+    flat_ref = jax.tree_util.tree_leaves_with_path(gp_ref)
+    flat_pal = dict(jax.tree_util.tree_leaves_with_path(gp_pal))
     for path, leaf in flat_ref:
         np.testing.assert_allclose(
             np.asarray(flat_pal[path]), np.asarray(leaf),

@@ -20,6 +20,8 @@ from distkeras_tpu.parallel.sharding import (
 )
 from distkeras_tpu.runtime.mesh import hybrid_mesh
 
+import envcaps
+
 B, L, H, D = 2, 32, 2, 8  # global seq L sharded over 4 chips -> 8 per chip
 
 
@@ -111,6 +113,7 @@ def test_tp_sharded_forward_matches_dense():
     np.testing.assert_allclose(np.asarray(out), np.asarray(expect), atol=3e-4)
 
 
+@envcaps.skip_unless_key_sharding()
 def test_flash_attention_under_tensor_parallelism():
     """attn_impl='flash' on a dp x tp mesh: the Mosaic kernel is manualized
     over the model axis by a nested shard_map (heads are independent), so

@@ -7,6 +7,9 @@ SAME data both ways — through ``racelab``'s genuinely-raced threaded PS (lock
 engines — across >=3 seeds, and final accuracies must agree within noise.
 """
 
+import threading
+import time
+
 import numpy as np
 import pytest
 
@@ -221,6 +224,51 @@ def test_raced_dynsgd_staleness_is_real():
     # regardless of how later rounds interleave into the commit order.
     assert log[0] == 0  # very first commit can never be stale
     assert log.max() >= W - 1, log[: 2 * W]
+
+
+def test_raced_ps_close_makes_workers_exit_cleanly():
+    """A closed server is typed-fatal, not silently absorbing: worker
+    threads blocked in a commit/pull loop exit with `ServerClosedError`
+    instead of folding into a dead center forever (the leaked-thread
+    failure mode `close()` exists to kill)."""
+    from distkeras_tpu.netps.errors import ServerClosedError
+    from distkeras_tpu.racelab import RacedParameterServer
+
+    rng = np.random.default_rng(0)
+    ps = RacedParameterServer([rng.normal(size=(4, 3)).astype(np.float32)],
+                              discipline="downpour")
+    started = threading.Barrier(3)
+    errors: list = []
+    done: list = []
+
+    def worker():
+        try:
+            started.wait()
+            while True:  # the forever-committing leaked worker
+                pulled, counter = ps.pull()
+                ps.commit([0.01 * np.sign(a) for a in pulled], counter)
+        except ServerClosedError:
+            done.append(True)  # the typed exit path — clean
+        except Exception as e:  # pragma: no cover - would fail the test
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(2)]
+    for t in threads:
+        t.start()
+    started.wait()
+    time.sleep(0.05)  # let commits genuinely race first
+    ps.close()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not any(t.is_alive() for t in threads), "worker thread leaked"
+    assert not errors, errors
+    assert len(done) == 2  # both exited through the typed error
+    assert len(ps.commit_log) > 0  # the race really ran before the close
+    with pytest.raises(ServerClosedError):
+        ps.commit([np.zeros((4, 3), np.float32)], 0)
+    with pytest.raises(ServerClosedError):
+        ps.pull()
+    ps.center()  # the final center stays readable after close
 
 
 def test_raced_ps_lock_order_witnessed():

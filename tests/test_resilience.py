@@ -359,6 +359,49 @@ def test_reset_workers_readopts_center():
         np.testing.assert_allclose(loc[1], cen + 1.0)  # untouched drift
 
 
+def test_reset_workers_edge_masks():
+    """Edge masks PR 2 never exercised: all-True re-adopts every worker,
+    all-False is an exact no-op, and both behave on a single-worker mesh."""
+    from distkeras_tpu.parallel.disciplines import ADAGFold
+    from distkeras_tpu.parallel.engine import AsyncEngine
+    from distkeras_tpu.runtime.mesh import data_mesh
+
+    def leaves(tree):
+        return jax.tree.leaves(jax.device_get(tree))
+
+    for W in (4, 1):
+        eng = AsyncEngine(tiny_model(), "sgd",
+                          "sparse_categorical_crossentropy", ADAGFold(),
+                          data_mesh(num_workers=W), window=4)
+        st = eng.init_state()
+        drifted = st._replace(
+            locals_=jax.tree.map(lambda a: a + 1.0, st.locals_),
+            opt_state=jax.tree.map(lambda a: a + 3.0, st.opt_state))
+        # all-False: nothing moves — locals, optimizer state, center.
+        noop = eng.reset_workers(drifted, np.zeros(W, bool))
+        for field in ("locals_", "opt_state", "center"):
+            for a, b in zip(leaves(getattr(noop, field)),
+                            leaves(getattr(drifted, field))):
+                np.testing.assert_array_equal(a, b)
+        # all-True: every worker re-adopts the center with a fresh optimizer.
+        fresh = eng.reset_workers(drifted, np.ones(W, bool))
+        for loc, cen in zip(leaves(fresh.locals_), leaves(fresh.center)):
+            for w in range(W):
+                np.testing.assert_allclose(loc[w], cen)
+        for opt, init in zip(leaves(fresh.opt_state),
+                             leaves(jax.tree.map(
+                                 lambda a: jnp.broadcast_to(
+                                     a, (W,) + a.shape),
+                                 eng.tx.init(jax.device_get(st.center))))):
+            np.testing.assert_allclose(opt, init)
+        # center and rng are untouched either way (the contract).
+        for a, b in zip(leaves(fresh.center), leaves(drifted.center)):
+            np.testing.assert_array_equal(a, b)
+        # wrong-shaped mask is a loud error, not silent broadcasting.
+        with pytest.raises(ValueError, match="worker_mask"):
+            eng.reset_workers(drifted, np.ones(W + 1, bool))
+
+
 def test_divergent_worker_reset_fires_on_poisoned_worker(monkeypatch):
     """One worker's loss goes non-finite (the round itself is skipped by the
     NaN guard); the divergence policy re-adopts the center for exactly that
@@ -369,6 +412,38 @@ def test_divergent_worker_reset_fires_on_poisoned_worker(monkeypatch):
     trained = t.train(blob_df(), shuffle=True)
     assert counter("resilience.worker_resets") - before == 1
     assert accuracy(trained, blob_df()) > 0.85
+
+
+# ---------------------------------------------------------------------------
+# Restart backoff: full jitter (shared with the netps client)
+# ---------------------------------------------------------------------------
+
+def test_full_jitter_bounds_and_decorrelation():
+    """The shared retry-delay rule: every draw lands in [0, cap) where cap
+    is the exponential envelope min(max, base * 2**attempt) — and the draws
+    actually vary (that is the anti-restart-storm point)."""
+    from distkeras_tpu.resilience.backoff import backoff_cap, full_jitter
+
+    rng = np.random.default_rng(0)
+    for attempt in range(8):
+        cap = backoff_cap(0.5, attempt, max_s=10.0)
+        assert cap == min(10.0, 0.5 * 2 ** attempt)
+        draws = [full_jitter(0.5, attempt, max_s=10.0, rng=rng)
+                 for _ in range(200)]
+        assert all(0.0 <= d < cap for d in draws), (attempt, min(draws),
+                                                    max(draws), cap)
+        # Decorrelated: the herd must not sleep in lockstep.
+        assert np.std(draws) > 0.05 * cap
+    # Degenerate bases short-circuit to zero (tests use backoff 0).
+    assert full_jitter(0.0, 3) == 0.0
+    assert backoff_cap(0.0, 3) == 0.0
+    # Supervisor and Job.supervise draw from this same rule.
+    import inspect
+
+    from distkeras_tpu import job_deployment
+    from distkeras_tpu.resilience import supervisor
+    assert "full_jitter" in inspect.getsource(supervisor.Supervisor.train)
+    assert "full_jitter" in inspect.getsource(job_deployment.Job.supervise)
 
 
 # ---------------------------------------------------------------------------
