@@ -431,6 +431,129 @@ def _measure_async_transformer(name, *, num_layers, d_model, num_heads, d_ff,
             "reps": stats["reps"]}
 
 
+def _measure_netps_transformer(name, *, num_layers, d_model, num_heads, d_ff,
+                               vocab, seq_len, batch, window=4, rounds=8,
+                               reps=3):
+    """Config #8: an AEASGD transformer trained THROUGH the networked
+    parameter server over loopback — the RPC overhead as a pinned number.
+
+    Three measurements on the SAME model and jitted window executable:
+
+    * ``inprocess``  — the AsyncEngine elastic fold (no RPC at all): the
+      ceiling the netps path chases;
+    * ``pr4``        — netps with the PR 4 data-plane knobs (serial loop,
+      f32 deltas, one connection; the zero-copy framing is unconditional);
+    * ``optimized``  — netps with the PR 5 data plane: compute/comms
+      overlap (`DKTPU_NET_INFLIGHT=2`), int8 deltas with error feedback,
+      and 2-way sharded striping.
+
+    The headline value is the optimized path; ``data_plane_ab`` records
+    all three plus the fraction of the in-process gap the optimizations
+    recover. Loopback TCP is the transport either way, so the A/B isolates
+    the data plane itself from model/compile effects."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distkeras_tpu.data.batching import make_batches
+    from distkeras_tpu.data.dataframe import DataFrame
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.transformer import TransformerLM
+    from distkeras_tpu.netps.remote import run_remote
+    from distkeras_tpu.netps.server import PSServer
+    from distkeras_tpu.ops.losses import get_loss
+    from distkeras_tpu.parallel.disciplines import get_discipline
+    from distkeras_tpu.parallel.engine import AsyncEngine, stage_round
+    from distkeras_tpu.runtime.mesh import data_mesh
+    from distkeras_tpu.workers import make_local_loop
+
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:  # CPU smoke: keep the comms-visible SHAPE, shrink sizes
+        num_layers, d_model, num_heads, d_ff = 2, 384, 4, 1536
+        vocab, seq_len, batch, window = 4096, 64, 2, 1
+        rounds, reps = 12, 2
+
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        model = Model.build(
+            TransformerLM(vocab_size=vocab, num_layers=num_layers,
+                          d_model=d_model, num_heads=num_heads, d_ff=d_ff,
+                          max_seq_len=seq_len,
+                          attn_impl="flash" if on_tpu else "dense",
+                          remat=on_tpu),
+            jnp.zeros((1, 1), jnp.int32))
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, vocab, size=(batch * window * rounds, seq_len))
+    df = DataFrame({"features": toks.astype(np.int32),
+                    "label": np.roll(toks, -1, 1).astype(np.int32)})
+    plan = make_batches(df, "features", "label", batch_size=batch,
+                        num_workers=1, window=window, num_epoch=1)
+    alpha = 0.05
+    dtype = "bfloat16" if on_tpu else None
+    lr = 1e-4
+    tx = optax.adam(lr)
+    loss_fn = get_loss("sparse_categorical_crossentropy")
+    tokens = plan.num_rounds * window * batch * seq_len
+
+    # -- in-process ceiling: the AsyncEngine elastic fold, same plan size --
+    engine = AsyncEngine(
+        model, "adam", "sparse_categorical_crossentropy",
+        get_discipline("aeasgd", alpha=alpha), data_mesh(num_workers=1),
+        window=window, learning_rate=lr, compute_dtype=dtype)
+    xs, ys = stage_round(engine, plan, 0)
+    carry = {"s": engine.init_state()}
+
+    def one(_i):
+        carry["s"], loss = engine._round_fn(carry["s"], xs, ys)
+        return loss
+
+    times = _time_steps(one, 1, plan.num_rounds, reps=reps)
+    inproc = _throughput_stats(times, tokens)["value"]
+
+    # -- the two netps loopback variants, one shared jitted window ---------
+    loop_fn = jax.jit(make_local_loop(
+        model.module, loss_fn, tx,
+        compute_dtype=jnp.bfloat16 if on_tpu else None))
+
+    def run_variant(**knobs):
+        elapsed = []
+        for rep in range(reps + 1):  # rep 0 = warmup (jit compile, sockets)
+            srv = PSServer(discipline="aeasgd").start()
+            try:
+                t0 = time.perf_counter()
+                run_remote(endpoint=srv.endpoint, model=model, tx=tx,
+                           loss_fn=loss_fn, plan=plan, discipline="aeasgd",
+                           window=window, alpha=alpha,
+                           compute_dtype=jnp.bfloat16 if on_tpu else None,
+                           loop_fn=loop_fn, **knobs)
+                if rep:
+                    elapsed.append(time.perf_counter() - t0)
+            finally:
+                srv.close()
+        return _throughput_stats(elapsed, tokens)
+
+    pr4 = run_variant(inflight=1, shards=1, compress="none")
+    opt = run_variant(inflight=2, shards=2, compress="int8")
+
+    gap = inproc - pr4["value"]
+    rec = {
+        "metric": f"{name}_tokens_per_sec_per_chip",
+        "value": round(opt["value"], 1), "unit": "tokens/s/chip",
+        "p50": opt["p50"], "p10": opt["p10"], "p90": opt["p90"],
+        "reps": opt["reps"],
+        "data_plane_ab": {
+            "inprocess_tokens_per_sec": round(inproc, 1),
+            "pr4_tokens_per_sec": round(pr4["value"], 1),
+            "optimized_tokens_per_sec": round(opt["value"], 1),
+            "optimized_vs_pr4": round(opt["value"] / pr4["value"], 3),
+            "rpc_gap_recovered": (
+                round((opt["value"] - pr4["value"]) / gap, 3)
+                if gap > 0 else None),
+            "knobs": {"inflight": 2, "compress": "int8", "shards": 2},
+        },
+    }
+    return rec
+
+
 def _measure_spmd_transformer(name, *, num_layers, d_model, num_heads, d_ff,
                               vocab, seq_len, batch, timed=12, warmup=2,
                               reps=None):
@@ -750,6 +873,19 @@ def main():
                     dict(num_layers=8, d_model=1024, num_heads=16, d_ff=4096,
                          vocab=32768, seq_len=2048, batch=8)))
 
+    # 8 - the netps data plane: an AEASGD transformer trained THROUGH the
+    # networked PS over loopback, A/B'd against the PR 4 data plane and the
+    # in-process fold on the same model + executable, so the RPC overhead
+    # (and what overlap/compression/striping recover of it) is a pinned
+    # number. The shape is deliberately comms-visible — a ~17M-param tree
+    # (68 MB f32 per pull/commit direction) with few tokens per round — so
+    # the A/B measures the WIRE, not the matmuls around it; that is also
+    # the regime where the netps gap to the in-process fold lives.
+    configs.append(("netps_loopback_aeasgd", None, "netps_transformer",
+                    dict(num_layers=4, d_model=512, num_heads=8, d_ff=2048,
+                         vocab=8192, seq_len=128, batch=4, window=2,
+                         rounds=12)))
+
     # Optional subset for debugging: BENCH_CONFIGS=cifar10,resnet python bench.py
     only = [s for s in os.environ.get("BENCH_CONFIGS", "").split(",") if s]
     if only:
@@ -771,6 +907,8 @@ def main():
                         rec = _measure_spmd_transformer(name, **kw)
                     elif discipline == "async_transformer":
                         rec = _measure_async_transformer(name, **kw)
+                    elif discipline == "netps_transformer":
+                        rec = _measure_netps_transformer(name, **kw)
                     else:
                         rec = _measure(name, model_fn, discipline, **kw)
                 break

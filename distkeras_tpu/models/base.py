@@ -28,7 +28,28 @@ def _coerce(v):
     return tuple(_coerce(x) for x in v) if isinstance(v, list) else v
 
 
-def normalize_features(x):
+_uint8_warned = [False]
+
+
+def _warn_uint8_rescale() -> None:
+    """One-time (per process) notice that the silent uint8 ``/255`` rule
+    fired — so a byte-valued NON-image feature store (mask, categorical
+    bytes) is never rescaled without a trace. Called from every site that
+    applies the rule (here and ``workers.make_local_loop``); fires at trace
+    time on jitted paths, which is exactly once per executable."""
+    if _uint8_warned[0]:
+        return
+    _uint8_warned[0] = True
+    import warnings
+
+    warnings.warn(
+        "uint8 features detected: applying the raw-image-bytes rule "
+        "(x / 255 as float32) on every train/predict path. If these bytes "
+        "are NOT an image, opt out with normalize_uint8=False on the "
+        "Model / Trainer / ModelPredictor.", stacklevel=3)
+
+
+def normalize_features(x, normalize_uint8: bool = True):
     """uint8 feature arrays are raw image bytes: ``x/255`` as float32.
 
     The one normalization rule, shared by the training loop
@@ -36,8 +57,15 @@ def normalize_features(x):
     dtype) and every inference path (:meth:`Model.apply`,
     ``predictors.ModelPredictor``) — uint8 stores must see identical inputs
     train-side and predict-side. Integer token/label inputs are int32/int64
-    and pass through untouched."""
-    if getattr(x, "dtype", None) == jnp.uint8:
+    and pass through untouched.
+
+    ``normalize_uint8=False`` opts out for byte-valued non-image features
+    (masks, byte categoricals): the array passes through untouched. The
+    flag threads from ``Model.normalize_uint8`` through Trainer and
+    ModelPredictor so train and predict can never disagree; when the rule
+    DOES fire on a uint8 store, a one-time warning says so."""
+    if normalize_uint8 and getattr(x, "dtype", None) == jnp.uint8:
+        _warn_uint8_rescale()
         return x.astype(jnp.float32) / 255.0
     return x
 
@@ -81,6 +109,11 @@ class Model:
     #: non-trainables. None for pure-functional models. Engines thread these
     #: through training and cross-replica-mean them at each fold.
     state: Any = None
+    #: apply the raw-image-bytes rule (uint8 -> /255 float32) on every
+    #: train/predict input. ``False`` opts byte-valued non-image features
+    #: out; the engines and predictors read THIS flag, so train and
+    #: inference can never disagree.
+    normalize_uint8: bool = True
 
     @classmethod
     def build(
@@ -88,6 +121,7 @@ class Model:
         module: nn.Module,
         sample_input: Any,
         seed: int = 0,
+        normalize_uint8: bool = True,
     ) -> "Model":
         """Initialize parameters by tracing ``module`` on ``sample_input``.
 
@@ -101,7 +135,8 @@ class Model:
         state = {k: v for k, v in variables.items() if k != "params"} or None
         spec = tuple(jax.ShapeDtypeStruct(jnp.shape(a), jnp.asarray(a).dtype)
                      for a in inputs)
-        return cls(module=module, params=params, sample_spec=spec, state=state)
+        return cls(module=module, params=params, sample_spec=spec,
+                   state=state, normalize_uint8=normalize_uint8)
 
     def apply(self, params, *inputs, train: bool = False, rng=None, state=None):
         """Pure forward pass — the jit-safe core of ``model.predict``/``train_on_batch``.
@@ -115,7 +150,8 @@ class Model:
         rngs = {"dropout": rng} if rng is not None else None
         variables = {"params": params, **((state if state is not None
                                            else self.state) or {})}
-        inputs = tuple(normalize_features(x) for x in inputs)
+        inputs = tuple(normalize_features(x, self.normalize_uint8)
+                       for x in inputs)
         return self.module.apply(variables, *inputs, train=train, rngs=rngs)
 
     def predict(self, *inputs):

@@ -7,6 +7,9 @@ production edges the reference never had:
 
 * :mod:`~distkeras_tpu.netps.wire` — length-prefixed, crc-checksummed
   binary frames with magic/version/size checks and request-id echo;
+  zero-copy on both directions (``sendmsg`` scatter-gather out,
+  ``recv_into`` one-buffer in) plus the capability-negotiated per-tensor
+  delta codecs (``DKTPU_NET_COMPRESS=bf16|int8``);
 * :mod:`~distkeras_tpu.netps.server` — :class:`PSServer`: one handler
   thread per connection, idempotent ``(worker_id, seq)`` commits,
   lease-based elastic membership (eviction + mid-run rejoin), graceful
@@ -23,7 +26,13 @@ production edges the reference never had:
   transfers to the network server by construction;
 * :mod:`~distkeras_tpu.netps.remote` — the worker loop the async trainers
   run under ``remote="host:port"`` (pull -> K jitted local steps ->
-  commit).
+  commit), double-buffered under ``DKTPU_NET_INFLIGHT`` so commits and
+  pull prefetches overlap the next window's compute.
+
+The data plane (compute/comms overlap, compressed deltas, sharded
+striping over ``DKTPU_NET_SHARDS`` connections, zero-copy frames) is
+documented in docs/PERFORMANCE.md "The netps data plane"; every knob is
+off by default and negotiated at join, so PR 4 peers interoperate.
 
 Run a standalone server with ``python -m distkeras_tpu.netps``; docs in
 docs/RESILIENCE.md ("Network faults & elastic membership").

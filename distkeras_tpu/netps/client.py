@@ -1,4 +1,4 @@
-"""The hardened parameter-server client: every edge guarded.
+"""The hardened parameter-server client: every edge guarded, fast by default.
 
 Where the reference's worker did ``socket.connect(); send(pickle)`` and
 hoped, every RPC here has
@@ -20,20 +20,42 @@ hoped, every RPC here has
   ``evicted=True`` so the worker loop discards its stale window and
   continues from a fresh pull.
 
-A failed attempt always tears the connection down and reconnects — stale
+The data plane on top of those guarantees (all capability-negotiated at
+join through the server's advertised :data:`~distkeras_tpu.netps.wire.CAPS`
+— a PR 4 peer is spoken to in the PR 4 dialect):
+
+* **Compressed deltas** (``DKTPU_NET_COMPRESS=bf16|int8``): commit tensors
+  are quantized per-tensor before transmission; under ``int8`` the
+  quantization error is carried forward as an **error-feedback residual**
+  (added to the next window's delta), so the bias a 4x-smaller wire
+  introduces is corrected over rounds instead of accumulating. The
+  residual is discarded on rejoin — it belongs to the discarded window
+  lineage.
+* **Sharded striping** (``DKTPU_NET_SHARDS=N``): the parameter tree's
+  tensors are striped (byte-balanced, deterministic) across N connections;
+  pulls and commits issue one concurrent sub-RPC per stripe and reassemble
+  before the caller sees anything. One logical commit keeps ONE ``seq``
+  across all stripes — the server assembles the stripes and folds exactly
+  once. A striped pull whose stripes straddled a concurrent fold (torn
+  read) is detected by the echoed update counters and re-pulled; after
+  ``_PULL_CONSISTENT_TRIES`` misses it falls back to one unsharded pull.
+
+A failed attempt always tears that connection down and reconnects — stale
 bytes die with the old socket, and the ``req`` id echo discards any
 duplicate replies that survive on a healthy one. Typed, **non-retryable**
 failures (:class:`ServerDrainingError`, :class:`LeaseExpiredError`)
 surface immediately.
 
-One client serves one worker thread; it is deliberately not thread-safe
-(the reference's one-socket-per-worker layout).
+One client serves one worker thread; public methods are not safe to call
+concurrently (the striped sub-RPCs inside one call run on the client's own
+pool over disjoint connections — that is the supported concurrency).
 """
 
 from __future__ import annotations
 
 import socket
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
@@ -59,6 +81,10 @@ _ERROR_TYPES = {
     "protocol": ProtocolError,
 }
 
+#: striped-pull consistency budget: whole-pull re-reads before falling back
+#: to one unsharded pull (a torn read needs a fold to land mid-pull — rare).
+_PULL_CONSISTENT_TRIES = 3
+
 
 class CommitResult(NamedTuple):
     """What happened to one commit: ``applied`` (folded now),
@@ -73,17 +99,32 @@ class CommitResult(NamedTuple):
     staleness: int
 
 
+class _Conn:
+    """One TCP connection with its own request-id stream (reply matching
+    is per-connection, so ids need only be unique per stream)."""
+
+    __slots__ = ("sock", "req", "ever_connected")
+
+    def __init__(self):
+        self.sock: Optional[socket.socket] = None
+        self.req = 0
+        self.ever_connected = False
+
+
 class PSClient:
-    """One worker's connection to a :class:`~distkeras_tpu.netps.server.
+    """One worker's connection(s) to a :class:`~distkeras_tpu.netps.server.
     PSServer` (or anything speaking the wire protocol, e.g. the chaos
-    proxy). ``timeout``/``retries``/``backoff`` default from the registry
-    (`DKTPU_NET_TIMEOUT` / `DKTPU_NET_RETRIES` / `DKTPU_NET_BACKOFF`)."""
+    proxy). ``timeout``/``retries``/``backoff``/``shards``/``compress``
+    default from the registry (`DKTPU_NET_TIMEOUT` / `DKTPU_NET_RETRIES` /
+    `DKTPU_NET_BACKOFF` / `DKTPU_NET_SHARDS` / `DKTPU_NET_COMPRESS`)."""
 
     def __init__(self, endpoint: str, worker_id: Optional[int] = None,
                  timeout: Optional[float] = None,
                  retries: Optional[int] = None,
                  backoff: Optional[float] = None,
-                 auto_rejoin: bool = True):
+                 auto_rejoin: bool = True,
+                 shards: Optional[int] = None,
+                 compress: Optional[str] = None):
         self._host, self._port = wire.split_endpoint(endpoint)
         self.endpoint = endpoint
         self.worker_id = worker_id
@@ -94,12 +135,27 @@ class PSClient:
         self.backoff = float(backoff if backoff is not None
                              else config.env_float("DKTPU_NET_BACKOFF"))
         self.auto_rejoin = auto_rejoin
+        #: requested data-plane features; what is actually used is the
+        #: join-negotiated subset (:attr:`codec` / :attr:`active_shards`).
+        self.shards = max(1, int(shards if shards is not None
+                                 else config.env_int("DKTPU_NET_SHARDS")))
+        requested = compress if compress is not None else wire.net_codec()
+        if requested not in wire.CODECS:
+            raise ValueError(f"unknown codec {requested!r}; "
+                             f"known: {list(wire.CODECS)}")
+        self.requested_codec = requested
+        #: negotiated at join; until then the PR 4 dialect (f32, 1 conn).
+        self.codec = wire.CODEC_NONE
+        self.active_shards = 1
         self.lease_s: Optional[float] = None
-        self._sock: Optional[socket.socket] = None
-        self._req = 0
+        self._conns = [_Conn() for _ in range(self.shards)]
+        self._pool: Optional[ThreadPoolExecutor] = None
+        #: tensor-index stripes per shard, from the joined center's shapes.
+        self._stripes: Optional[list] = None
+        #: int8 error-feedback residual, one f32 array per delta tensor.
+        self._residual: Optional[list] = None
         self._seq = -1
         self._closed = False
-        self._ever_connected = False
         #: times this client re-joined after an eviction (worker loops
         #: watch it to re-adopt the center on rejoin).
         self.rejoin_count = 0
@@ -107,7 +163,11 @@ class PSClient:
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:
         self._closed = True
-        self._disconnect()
+        for conn in self._conns:
+            self._disconnect(conn)
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
 
     def __enter__(self) -> "PSClient":
         return self
@@ -115,12 +175,12 @@ class PSClient:
     def __exit__(self, *exc) -> None:
         self.close()
 
-    def _connect(self, deadline: float) -> socket.socket:
-        if self._sock is not None:
-            return self._sock
+    def _connect(self, conn: _Conn, deadline: float) -> socket.socket:
+        if conn.sock is not None:
+            return conn.sock
         from distkeras_tpu import telemetry
 
-        if self._ever_connected:
+        if conn.ever_connected:
             telemetry.counter("netps.reconnects").add(1)
         # The connect spends from the SAME per-attempt budget as the send
         # and reply (the documented contract): against a SYN-blackholing
@@ -131,40 +191,53 @@ class PSClient:
         sock = socket.create_connection((self._host, self._port),
                                         timeout=remaining)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock = sock
-        self._ever_connected = True
+        conn.sock = sock
+        conn.ever_connected = True
         return sock
 
-    def _disconnect(self) -> None:
-        if self._sock is not None:
+    @staticmethod
+    def _disconnect(conn: _Conn) -> None:
+        if conn.sock is not None:
             try:
-                self._sock.close()
+                conn.sock.close()
             except OSError:
                 pass
-            self._sock = None
+            conn.sock = None
+
+    def _shard_pool(self) -> ThreadPoolExecutor:
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.active_shards,
+                thread_name_prefix="netps-stripe")
+        return self._pool
 
     # -- the guarded RPC core ----------------------------------------------
-    def _rpc(self, op: str, header: dict,
-             arrays: Sequence[np.ndarray] = ()) -> tuple[dict, list]:
+    def _rpc(self, op: str, header: dict, arrays: Sequence = (),
+             conn_idx: int = 0) -> tuple[dict, list]:
         if self._closed:
             raise ServerClosedError(f"client to {self.endpoint} is closed")
         from distkeras_tpu import telemetry
 
+        conn = self._conns[conn_idx]
         attempts = self.retries + 1
         last_exc: Optional[BaseException] = None
-        with telemetry.span(f"netps.rpc.{op}"):
+        # Per-shard RPC spans: stripe sub-RPCs are labeled by their shard so
+        # the report can show per-stripe latency skew.
+        label = (f"netps.rpc.{op}.s{header['shard']}"
+                 if "shard" in header else f"netps.rpc.{op}")
+        with telemetry.span(label):
             for attempt in range(attempts):
-                self._req += 1
-                req = self._req
+                conn.req += 1
+                req = conn.req
                 hdr = dict(header, op=op, req=req)
                 if self.worker_id is not None:
                     hdr.setdefault("worker_id", int(self.worker_id))
                 try:
-                    return self._attempt(req, hdr, arrays)
+                    return self._attempt(conn, req, hdr, arrays)
                 except (socket.timeout, ConnectionError, OSError,
                         ProtocolError) as e:
                     last_exc = e
-                    self._disconnect()
+                    self._disconnect(conn)
                     if attempt + 1 < attempts:
                         telemetry.counter("netps.retries").add(1)
                         time.sleep(full_jitter(self.backoff, attempt))
@@ -174,13 +247,13 @@ class PSClient:
             f"(last: {type(last_exc).__name__}: {last_exc})",
             attempts=attempts)
 
-    def _attempt(self, req: int, hdr: dict,
-                 arrays: Sequence[np.ndarray]) -> tuple[dict, list]:
+    def _attempt(self, conn: _Conn, req: int, hdr: dict,
+                 arrays: Sequence) -> tuple[dict, list]:
         """One connect + send + matched-reply receive under ONE deadline."""
         from distkeras_tpu import telemetry
 
         deadline = time.monotonic() + self.timeout
-        sock = self._connect(deadline)
+        sock = self._connect(conn, deadline)
         sock.settimeout(max(0.001, deadline - time.monotonic()))
         sent = wire.send_frame(sock, wire.KIND_REQUEST, hdr, arrays)
         telemetry.counter("netps.bytes_sent").add(sent)
@@ -189,8 +262,8 @@ class PSClient:
             if remaining <= 0:
                 raise socket.timeout(f"{hdr['op']} deadline exceeded")
             sock.settimeout(remaining)
-            raw = wire.read_raw_frame(sock)
-            kind, rhdr, rarrays = wire.decode_frame(raw)
+            prefix = wire.recv_exact(sock, wire.PREFIX_SIZE)
+            kind, nbytes, rhdr, rarrays = wire.finish_frame(sock, prefix)
             if kind != wire.KIND_REPLY:
                 raise ProtocolError(f"expected a reply frame, got kind {kind}")
             if rhdr.get("req") != req:
@@ -198,7 +271,7 @@ class PSClient:
                 # reading — the req echo is what keeps the stream sane.
                 telemetry.counter("netps.stale_replies").add(1)
                 continue
-            telemetry.counter("netps.bytes_received").add(len(raw))
+            telemetry.counter("netps.bytes_received").add(nbytes)
             err = rhdr.get("error")
             if err:
                 exc = _ERROR_TYPES.get(err, NetPSError)
@@ -206,15 +279,71 @@ class PSClient:
                           f"{rhdr.get('message', '')}")
             return rhdr, rarrays
 
+    # -- striping helpers ---------------------------------------------------
+    def _compute_stripes(self, template: Sequence[np.ndarray]) -> None:
+        """Byte-balanced greedy stripe assignment of tensor indices over the
+        active shard connections, from the joined center's shapes.
+        Deterministic; the indices ride in every stripe header, so the
+        server never recomputes it."""
+        n = min(self.active_shards, max(1, len(template)))
+        if n <= 1:
+            self._stripes = None
+            return
+        order = sorted(range(len(template)),
+                       key=lambda i: (-int(np.asarray(template[i]).nbytes), i))
+        loads = [0] * n
+        stripes: list = [[] for _ in range(n)]
+        for i in order:
+            s = loads.index(min(loads))
+            stripes[s].append(i)
+            loads[s] += int(np.asarray(template[i]).nbytes)
+        for st in stripes:
+            st.sort()
+        self._stripes = stripes
+
+    def _striped(self) -> bool:
+        return (self.active_shards > 1 and self._stripes is not None
+                and len(self._stripes) > 1)
+
+    def _gather(self, futures: list) -> list:
+        """Results of stripe futures; waits for ALL (no socket left with an
+        in-flight reply), then re-raises the highest-priority failure —
+        lease expiry beats transport errors (the caller's rejoin handles
+        it; a retry cannot)."""
+        results, errors = [], []
+        for f in futures:
+            try:
+                results.append(f.result())
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errors.append(e)
+        if errors:
+            for e in errors:
+                if isinstance(e, LeaseExpiredError):
+                    raise e
+            raise errors[0]
+        return results
+
     # -- RPC surface --------------------------------------------------------
     def join(self, init: Optional[Sequence[np.ndarray]] = None,
              ) -> tuple[list, int]:
         """Become (or re-become) a member; returns ``(center, updates)``.
         ``init`` seeds an uninitialized server (first joiner wins; later
-        inits are ignored — everyone adopts the server's center)."""
-        hdr, center = self._rpc("join", {}, list(init or ()))
+        inits are ignored — everyone adopts the server's center). The
+        join reply's advertised capabilities select the wire dialect
+        (codec + striping) for every later pull/commit."""
+        hdr, center = self._rpc("join", {"caps": wire.CAPS},
+                                list(init or ()))
         self.worker_id = int(hdr["worker_id"])
         self.lease_s = hdr.get("lease_s")
+        caps = hdr.get("caps") or {}
+        self.codec = (self.requested_codec
+                      if self.requested_codec in caps.get("codecs", ())
+                      else wire.CODEC_NONE)
+        self.active_shards = self.shards if caps.get("striping") else 1
+        self._compute_stripes(center)
+        # Error feedback restarts on every (re)join: the residual belongs
+        # to the window lineage the rejoin just discarded.
+        self._residual = None
         # Resume the commit sequence past what the server already folded
         # from this worker_id: a restarted worker process starts at seq -1,
         # and without adopting the server's high-water mark every commit of
@@ -226,8 +355,13 @@ class PSClient:
 
     def pull(self) -> tuple[list, int]:
         """Current center + update counter; renews the lease. An evicted
-        client transparently re-joins first (``auto_rejoin``)."""
+        client transparently re-joins first (``auto_rejoin``). Striped
+        pulls reassemble a consistency-checked center (torn reads across a
+        concurrent fold are detected via the echoed counters and
+        re-pulled)."""
         try:
+            if self._striped():
+                return self._striped_pull()
             hdr, center = self._rpc("pull", {})
         except LeaseExpiredError:
             if not self.auto_rejoin:
@@ -236,21 +370,89 @@ class PSClient:
             return self.join()
         return center, int(hdr["updates"])
 
+    def _striped_pull(self) -> tuple[list, int]:
+        pool = self._shard_pool()
+        stripes = self._stripes
+        total = sum(len(s) for s in stripes)
+        for _ in range(_PULL_CONSISTENT_TRIES):
+            futures = [
+                pool.submit(self._rpc, "pull",
+                            {"shard": s, "num_shards": len(stripes),
+                             "idx": idx}, (), s)
+                for s, idx in enumerate(stripes)]
+            replies = self._gather(futures)
+            counters = {int(h["updates"]) for h, _ in replies}
+            if len(counters) == 1:
+                center: list = [None] * total
+                for (_h, arrays), idx in zip(replies, stripes):
+                    for i, a in zip(idx, arrays):
+                        center[i] = a
+                return center, counters.pop()
+            # A fold landed between stripe reads: torn center — re-read.
+            from distkeras_tpu import telemetry
+
+            telemetry.counter("netps.pull_torn_retries").add(1)
+        # Persistent contention: one unsharded pull is always consistent.
+        hdr, center = self._rpc("pull", {})
+        return center, int(hdr["updates"])
+
+    def _compress_delta(self, delta: Sequence[np.ndarray]) -> list:
+        """Delta tensors -> wire items under the negotiated codec, updating
+        the int8 error-feedback residual (quantization error carried into
+        the NEXT commit, so the wire's bias corrects over rounds)."""
+        from distkeras_tpu import telemetry
+
+        delta = [np.ascontiguousarray(d, np.float32) for d in delta]
+        telemetry.counter("netps.bytes_precompress").add(
+            sum(d.nbytes for d in delta))
+        if self.codec == wire.CODEC_NONE:
+            return delta
+        if self.codec == wire.CODEC_INT8 and self._residual is None:
+            self._residual = [np.zeros_like(d) for d in delta]
+        items = []
+        for i, d in enumerate(delta):
+            if self.codec == wire.CODEC_INT8:
+                d = d + self._residual[i]
+            encoded, extras = wire.codec_encode(d, self.codec)
+            if self.codec == wire.CODEC_INT8:
+                self._residual[i] = d - wire.codec_decode(encoded, extras)
+            items.append((encoded, extras) if extras else encoded)
+        return items
+
     def commit(self, delta: Sequence[np.ndarray],
                pulled_counter: int) -> CommitResult:
         """Fold ``delta`` (worker-normalized) into the center. The seq is
         assigned before the first transmission and reused across retries:
-        a lost ACK can never double-fold."""
+        a lost ACK can never double-fold. With striping, ONE seq spans all
+        stripe sub-RPCs — the server assembles them and folds once."""
         self._seq += 1
         seq = self._seq
+        items = self._compress_delta(delta)
+        base = {"seq": seq, "pulled": int(pulled_counter)}
         try:
-            hdr, _ = self._rpc(
-                "commit", {"seq": seq, "pulled": int(pulled_counter)},
-                list(delta))
+            if self._striped() and len(items) == sum(
+                    len(s) for s in self._stripes):
+                hdr = self._striped_commit(base, items)
+            else:
+                hdr, _ = self._rpc("commit", base, items)
         except LeaseExpiredError:
             if not self.auto_rejoin:
                 raise
             self.rejoin_count += 1
+            self.join()
+            return CommitResult(applied=False, duplicate=False, evicted=True,
+                                updates=-1, staleness=-1)
+        if hdr is None:
+            # Every stripe answered ``pending``: membership churn (an
+            # eviction sweep or a concurrent rejoin purging the server's
+            # half-assembled stripe set) lost this commit — it was NEVER
+            # folded and never will be. Same recovery as an evicted
+            # commit: discard the window, refresh membership + the
+            # server's pending state, continue from a fresh pull.
+            if not self.auto_rejoin:
+                raise NetPSError(
+                    "striped commit never completed: every stripe is "
+                    "pending — the server lost part of the stripe set")
             self.join()
             return CommitResult(applied=False, duplicate=False, evicted=True,
                                 updates=-1, staleness=-1)
@@ -259,6 +461,31 @@ class PSClient:
             duplicate=bool(hdr.get("duplicate")),
             evicted=False, updates=int(hdr["updates"]),
             staleness=int(hdr.get("staleness", -1)))
+
+    def _striped_commit(self, base: dict, items: list) -> Optional[dict]:
+        """One logical commit over the stripe connections; returns the
+        fold-outcome header, or None when every stripe came back
+        ``pending`` (the server lost part of the set to membership churn —
+        :meth:`commit` recovers via the evicted path)."""
+        stripes = self._stripes
+        pool = self._shard_pool()
+        futures = [
+            pool.submit(
+                self._rpc, "commit",
+                dict(base, shard=s, num_shards=len(stripes), idx=idx),
+                [items[i] for i in idx], s)
+            for s, idx in enumerate(stripes)]
+        replies = self._gather(futures)
+        # Exactly one stripe's reply carries the fold outcome (the one that
+        # completed the assembly, or the dedup answer); the rest say
+        # ``pending``.
+        for hdr, _ in replies:
+            if hdr.get("applied"):
+                return hdr
+        for hdr, _ in replies:
+            if hdr.get("duplicate"):
+                return hdr
+        return None
 
     def heartbeat(self) -> int:
         """Renew the lease; returns the server's update counter."""

@@ -10,12 +10,30 @@ uses (``racelab.run_raced``), and the same server-side fold
 worker threads genuinely interleave; commit order is whatever the network
 and the OS deliver — the reference's architecture, end to end.
 
+**Compute/communication overlap** (``DKTPU_NET_INFLIGHT``): with the
+default of 1 the loop is the serial PR 4 one — round *r*'s commit is
+ACKed before round *r+1* begins. Raising it double-buffers the loop:
+round *r*'s commit (and the next round's pull prefetch) run on background
+comms threads while round *r+1*'s K jitted local steps execute, with at
+most ``DKTPU_NET_INFLIGHT`` commits un-ACKed at any time. Commits still
+leave in strict seq order (one ordered comms lane per worker), so the
+exactly-once dedup story is untouched. The price is staleness: a
+prefetched pull cannot contain the still-in-flight commits, so the
+server's counter rule *naturally* charges the realized in-flight delay —
+DynSGD's ``1/(staleness+1)`` scale and the staleness telemetry
+(``netps.commit.staleness`` histogram + the ``discipline.staleness_*``
+gauges the DisciplineMonitor exports) see the TRUE realized staleness,
+not the serial loop's. The overlap's effectiveness is exported as the
+``netps.overlap.hidden_fraction`` gauge (1 − visible comms wait / total
+comms time).
+
 Elastic membership in the loop: a worker that went silent past its lease
 (injected via the ``evict@R:S`` net fault, or a real stall) finds itself
 evicted at the next RPC; the client re-joins automatically, the worker
-discards its stale window, re-adopts the freshly pulled center (the
-reference's rejoining-worker semantics), and training continues — no
-global restart, and the survivors never stopped.
+discards its stale window (including any in-flight commits — their
+evicted results drain into a re-adopt), re-adopts the freshly pulled
+center (the reference's rejoining-worker semantics), and training
+continues — no global restart, and the survivors never stopped.
 
 Mutable model state (BatchNorm stats) stays per-worker and unsynced here —
 the reference's socket server only ever carried parameters.
@@ -29,16 +47,19 @@ assignment is plumbed through ``Job``.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
 import numpy as np
 
 from distkeras_tpu.data.batching import BatchPlan, apply_round_transform
-from distkeras_tpu.netps.client import PSClient
+from distkeras_tpu.netps.client import CommitResult, PSClient
 from distkeras_tpu.netps.fold import check_discipline
 from distkeras_tpu.resilience import faults as _faults
+from distkeras_tpu.runtime import config
 
 
 def _leaves(tree) -> list:
@@ -60,6 +81,65 @@ def _worker_round(plan: BatchPlan, r: int, w: int):
     return xs, ys
 
 
+class _CommsMeter:
+    """Run-wide comms accounting shared by the worker threads: total RPC
+    busy time vs the wait the compute loop actually *saw*, plus the
+    realized staleness of applied commits — the overlap evidence."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.busy = 0.0
+        self.wait = 0.0
+        self.stale = collections.deque(maxlen=256)
+
+    def timed(self, fn, *args):
+        """Run one RPC, charging its duration to ``busy`` (called on the
+        comms threads)."""
+        t0 = time.monotonic()
+        try:
+            return fn(*args)
+        finally:
+            with self.lock:
+                self.busy += time.monotonic() - t0
+
+    def blocking(self, fn, *args):
+        """An RPC the compute thread itself waits through (round 0's pull,
+        the serial loop): busy AND wait — nothing of it was hidden."""
+        t0 = time.monotonic()
+        try:
+            return self.timed(fn, *args)
+        finally:
+            self.waited(time.monotonic() - t0)
+
+    def waited(self, seconds: float) -> None:
+        with self.lock:
+            self.wait += seconds
+
+    def commit_staleness(self, staleness: int) -> None:
+        from distkeras_tpu import telemetry
+
+        telemetry.histogram("netps.commit.staleness").observe(
+            float(staleness))
+        with self.lock:
+            self.stale.append(int(staleness))
+            vals = list(self.stale)
+        # The same gauges DisciplineMonitor exports for in-process engines,
+        # fed the REALIZED staleness the server charged (which includes any
+        # in-flight overlap delay) instead of the analytic rotation.
+        telemetry.gauge("discipline.staleness_mean").set(
+            float(np.mean(vals)))
+        telemetry.gauge("discipline.staleness_max").set(float(max(vals)))
+
+    def export(self) -> None:
+        from distkeras_tpu import telemetry
+
+        with self.lock:
+            busy, wait = self.busy, self.wait
+        if busy > 0:
+            telemetry.gauge("netps.overlap.hidden_fraction").set(
+                round(max(0.0, min(1.0, 1.0 - wait / busy)), 4))
+
+
 def run_remote(
     *,
     endpoint: str,
@@ -76,6 +156,10 @@ def run_remote(
     timeout: Optional[float] = None,
     retries: Optional[int] = None,
     backoff: Optional[float] = None,
+    inflight: Optional[int] = None,
+    shards: Optional[int] = None,
+    compress: Optional[str] = None,
+    loop_fn=None,
 ) -> tuple[Any, np.ndarray]:
     """Train ``plan.num_workers`` threads against the PS at ``endpoint``.
 
@@ -83,6 +167,9 @@ def run_remote(
     the server's final center. Rows of ``losses`` for a round a worker's
     commit was discarded (eviction) still carry that worker's local loss;
     NaN marks rounds a worker never ran (it was asleep being evicted).
+
+    ``inflight``/``shards``/``compress`` default from the registry
+    (``DKTPU_NET_INFLIGHT``/``DKTPU_NET_SHARDS``/``DKTPU_NET_COMPRESS``).
 
     The first joiner seeds an uninitialized server with this model's
     params, so a bare ``python -m distkeras_tpu.netps`` server needs no
@@ -95,24 +182,51 @@ def run_remote(
 
     check_discipline(discipline)
     W = plan.num_workers
+    inflight = max(1, int(inflight if inflight is not None
+                          else config.env_int("DKTPU_NET_INFLIGHT")))
     elastic = discipline in ("aeasgd", "eamsgd")
     treedef = jax.tree.structure(model.params)
     init_leaves = _leaves(model.params)
-    loop_fn = jax.jit(make_local_loop(
-        model.module, loss_fn, tx, compute_dtype=compute_dtype,
-        state_collections=model.state_collections, grad_accum=grad_accum))
+    if loop_fn is None:
+        # Callers may pass a prebuilt jitted loop (bench.py A/Bs data-plane
+        # variants against ONE compiled executable).
+        loop_fn = jax.jit(make_local_loop(
+            model.module, loss_fn, tx, compute_dtype=compute_dtype,
+            state_collections=model.state_collections, grad_accum=grad_accum,
+            normalize_uint8=getattr(model, "normalize_uint8", True)))
     losses = np.full((plan.num_rounds, W), np.nan, np.float32)
     errors: list = []
     base_key = jax.random.key(seed)
+    meter = _CommsMeter()
+    client_kw = dict(timeout=timeout, retries=retries, backoff=backoff,
+                     shards=shards, compress=compress)
 
     def unflatten(leaves):
         return jax.tree.unflatten(treedef, [np.asarray(a) for a in leaves])
 
     def work(w: int) -> None:
-        client = PSClient(endpoint, worker_id=w, timeout=timeout,
-                          retries=retries, backoff=backoff)
+        client = PSClient(endpoint, worker_id=w, **client_kw)
+        pull_client: Optional[PSClient] = None
+        commit_lane = pull_lane = None
+        if inflight > 1:
+            # Two comms lanes per worker: an ORDERED commit lane (seq order
+            # is the exactly-once contract) and a pull-prefetch lane on its
+            # own client/connections, so a slow commit cannot serialize the
+            # next round's pull behind it.
+            commit_lane = ThreadPoolExecutor(
+                1, thread_name_prefix=f"netps-commit-{w}")
+            pull_lane = ThreadPoolExecutor(
+                1, thread_name_prefix=f"netps-pull-{w}")
         try:
             center_leaves, counter = client.join(init=init_leaves)
+            if inflight > 1:
+                pull_client = PSClient(endpoint, worker_id=client.worker_id,
+                                       **client_kw)
+                # Striping state without a join: adopt the negotiated
+                # dialect (membership is by worker_id, not by connection).
+                pull_client.codec = client.codec
+                pull_client.active_shards = client.active_shards
+                pull_client._compute_stripes(center_leaves)
             params = unflatten(center_leaves)
             opt_state = tx.init(params)
             local = params if elastic else None
@@ -120,6 +234,43 @@ def run_remote(
                       if model.state is not None else None)
             readopt = False
             rejoins_seen = 0
+            pending: collections.deque = collections.deque()
+            next_pull = None
+
+            def rejoins() -> int:
+                n = client.rejoin_count
+                if pull_client is not None:
+                    n += pull_client.rejoin_count
+                return n
+
+            def guarded_commit(delta, counter, epoch):
+                # Ordered-lane lineage guard: a commit queued BEFORE an
+                # eviction-triggered rejoin (its delta was computed from
+                # the pre-eviction pull lineage) must be discarded, not
+                # folded into the fresh center — the same "discard the
+                # stale window" semantics the serial loop gets for free.
+                # The lane is ordered, so by the time this runs any rejoin
+                # caused by an earlier queued commit is already counted.
+                if rejoins() != epoch:
+                    return CommitResult(applied=False, duplicate=False,
+                                        evicted=True, updates=-1,
+                                        staleness=-1)
+                return client.commit(delta, counter)
+
+            def drain_one() -> None:
+                nonlocal readopt
+                _r, fut = pending.popleft()
+                t0 = time.monotonic()
+                res = fut.result()
+                meter.waited(time.monotonic() - t0)
+                if res.evicted:
+                    # The lease lapsed with this commit in flight: it was
+                    # discarded and the client already re-joined. Start
+                    # over from the fresh center at the next pull.
+                    readopt = True
+                elif res.applied:
+                    meter.commit_staleness(res.staleness)
+
             for r in range(plan.num_rounds):
                 net = _faults.active_net_plan()
                 if net is not None and net.poison_worker(r, W) == w:
@@ -129,12 +280,18 @@ def run_remote(
                         # the next RPC re-joins and we continue.
                         lease = client.lease_s or 1.0
                         time.sleep(arg if arg > 0 else 2.0 * lease)
-                pulled_leaves, counter = client.pull()
-                if client.rejoin_count > rejoins_seen or readopt:
+                if next_pull is not None:
+                    t0 = time.monotonic()
+                    pulled_leaves, counter = next_pull.result()
+                    meter.waited(time.monotonic() - t0)
+                    next_pull = None
+                else:
+                    pulled_leaves, counter = meter.blocking(client.pull)
+                if rejoins() > rejoins_seen or readopt:
                     # Evicted while away: the rejoining worker re-adopts
                     # the center (fresh replica + optimizer — the
                     # reference's PS-pull join semantics).
-                    rejoins_seen = client.rejoin_count
+                    rejoins_seen = rejoins()
                     readopt = False
                     if elastic:
                         local = unflatten(pulled_leaves)
@@ -152,22 +309,40 @@ def run_remote(
                          for n, p in zip(new_leaves, pulled_np)]
                     local = unflatten([n - d
                                        for n, d in zip(new_leaves, e)])
-                    res = client.commit(e, counter)
+                    delta = e
                 else:
                     delta = [n - p for n, p in zip(new_leaves, pulled_np)]
                     if discipline == "adag":
                         delta = [d / float(window) for d in delta]
-                    res = client.commit(delta, counter)
-                if res.evicted:
-                    # The lease lapsed inside this window: the commit was
-                    # discarded and the client already re-joined. Start
-                    # over from the fresh center next round.
-                    readopt = True
+                if commit_lane is not None:
+                    while len(pending) >= inflight:
+                        drain_one()
+                    fut = commit_lane.submit(
+                        meter.timed, guarded_commit, delta, counter,
+                        rejoins())
+                    pending.append((r, fut))
+                    if r + 1 < plan.num_rounds:
+                        next_pull = pull_lane.submit(
+                            meter.timed, pull_client.pull)
+                else:
+                    res = meter.blocking(client.commit, delta, counter)
+                    if res.evicted:
+                        readopt = True
+                    elif res.applied:
+                        meter.commit_staleness(res.staleness)
                 losses[r, w] = float(np.mean(np.asarray(window_losses)))
+            while pending:
+                drain_one()
             client.leave()
         except BaseException as e:  # noqa: BLE001 - surface on main thread
             errors.append(e)
         finally:
+            if commit_lane is not None:
+                commit_lane.shutdown(wait=True)
+            if pull_lane is not None:
+                pull_lane.shutdown(wait=True)
+            if pull_client is not None:
+                pull_client.close()
             client.close()
 
     with telemetry.span("netps.remote_train"):
@@ -178,6 +353,10 @@ def run_remote(
             t.start()
         for t in threads:
             t.join()
+    if inflight > 1:
+        # The gauge is OVERLAP evidence; the serial loop hides nothing by
+        # construction, so exporting there would just report its absence.
+        meter.export()
     if errors:
         raise errors[0]
     with PSClient(endpoint, timeout=timeout, retries=retries,

@@ -73,6 +73,13 @@ class PSServer:
         self._last_seq: dict = {}
         #: every worker_id ever admitted (rejoin accounting + id assignment).
         self._ever: set = set()
+        #: striped commits awaiting assembly: (worker_id, seq) ->
+        #: {shard: (idx tuple, arrays)}. One logical commit spans
+        #: ``num_shards`` stripe sub-requests under ONE seq; the stripe
+        #: that completes the set triggers the single fold. Purged on
+        #: eviction and (re)join — a dead worker's half-commit must not
+        #: linger.
+        self._pending: dict = {}
         #: applied commits in fold order: (worker_id, seq, staleness) — the
         #: exactly-once evidence the chaos tests assert on.
         self.commit_log: list = []
@@ -177,6 +184,7 @@ class PSServer:
                 for w in expired:
                     del self._members[w]
                     self.evictions += 1
+                    self._purge_pending(w)
             for w in expired:
                 telemetry.counter("netps.evictions").add(1)
                 telemetry.event("netps_eviction", {"worker": w})
@@ -200,9 +208,11 @@ class PSServer:
                     return
                 try:
                     conn.settimeout(_FRAME_COMPLETE_S)
-                    raw = wire.finish_raw_frame(conn, prefix)
+                    # Zero-copy: the body lands in one preallocated buffer
+                    # and the arrays are views over it (wire.finish_frame).
+                    kind, nbytes, header, arrays = wire.finish_frame(
+                        conn, prefix)
                     conn.settimeout(_POLL_S)
-                    kind, header, arrays = wire.decode_frame(raw)
                 except (socket.timeout, ConnectionError, OSError):
                     return
                 except ProtocolError:
@@ -213,7 +223,7 @@ class PSServer:
                 if kind != wire.KIND_REQUEST:
                     telemetry.counter("netps.protocol_errors").add(1)
                     return
-                telemetry.counter("netps.bytes_received").add(len(raw))
+                telemetry.counter("netps.bytes_received").add(nbytes)
                 op = header.get("op", "")
                 with telemetry.span(f"netps.server.{op or 'unknown'}"):
                     reply, out = self._dispatch(op, header, arrays)
@@ -242,6 +252,16 @@ class PSServer:
     def _err(kind: str, message: str) -> tuple[dict, list]:
         return {"error": kind, "message": message}, []
 
+    def _purge_pending(self, wid: int, below_seq: Optional[int] = None,
+                       ) -> None:
+        """Drop stashed commit stripes for ``wid`` (lock held by caller):
+        all of them on eviction/rejoin, or only seqs <= ``below_seq`` after
+        a fold (a completed commit's stragglers are dedup's problem)."""
+        for key in [k for k in self._pending
+                    if k[0] == wid
+                    and (below_seq is None or k[1] <= below_seq)]:
+            del self._pending[key]
+
     def _op_join(self, header: dict, arrays: list) -> tuple[dict, list]:
         from distkeras_tpu import telemetry
 
@@ -262,6 +282,7 @@ class PSServer:
                     "server has no center yet; join with init arrays")
             self._ever.add(wid)
             self._members[wid] = time.monotonic() + self.lease_s
+            self._purge_pending(wid)  # a rejoin abandons half-sent stripes
             if rejoin:
                 self.rejoins += 1
             center = [a.copy() for a in self._center]
@@ -273,12 +294,17 @@ class PSServer:
         # last_seq lets a RESTARTED worker process (fresh client, seq
         # counter back at -1) resume its sequence past what this server
         # already folded — without it, dedup would silently discard every
-        # commit of the restarted incarnation forever.
+        # commit of the restarted incarnation forever. ``caps`` is the
+        # data-plane negotiation: the client only compresses/stripes what
+        # this reply advertises (a capability-less PR 4 reply keeps old
+        # clients on the f32 single-connection dialect).
         return ({"ok": True, "worker_id": wid, "updates": updates,
-                 "lease_s": self.lease_s, "last_seq": last_seq}, center)
+                 "lease_s": self.lease_s, "last_seq": last_seq,
+                 "caps": wire.CAPS}, center)
 
     def _op_pull(self, header: dict) -> tuple[dict, list]:
         wid = header.get("worker_id")
+        idx = header.get("idx")
         with self._lock:
             if self._center is None:
                 return self._err("uninitialized", "no center yet")
@@ -290,8 +316,18 @@ class PSServer:
                     return self._err(
                         "lease_expired", f"worker {wid} is not a member")
                 self._members[int(wid)] = time.monotonic() + self.lease_s
-            return ({"ok": True, "updates": self._updates},
-                    [a.copy() for a in self._center])
+            if idx is None:
+                out = [a.copy() for a in self._center]
+            else:
+                # One stripe of the center (striped pull). The reply echoes
+                # the update counter; the client cross-checks counters over
+                # its stripes and re-pulls a torn read.
+                try:
+                    out = [self._center[int(i)].copy() for i in idx]
+                except (IndexError, TypeError, ValueError):
+                    return self._err(
+                        "protocol", f"bad pull stripe indices {idx!r}")
+            return {"ok": True, "updates": self._updates}, out
 
     def _op_commit(self, header: dict, arrays: list) -> tuple[dict, list]:
         from distkeras_tpu import telemetry
@@ -302,7 +338,8 @@ class PSServer:
         if wid is None or seq is None:
             return self._err("protocol", "commit requires worker_id and seq")
         wid, seq = int(wid), int(seq)
-        duplicate = False
+        num_shards = int(header.get("num_shards", 1) or 1)
+        duplicate = pending = False
         with self._lock:
             if self._draining:
                 return self._err("draining", "server is draining")
@@ -315,23 +352,79 @@ class PSServer:
             if seq <= self._last_seq.get(wid, -1):
                 # Retransmit after a lost ACK: already folded. Answering
                 # applied=False (instead of re-folding) is the whole
-                # exactly-once story.
+                # exactly-once story — and with striping it covers a
+                # retransmitted stripe of an already-assembled commit too.
                 duplicate = True
                 staleness = -1
+            elif num_shards > 1:
+                delta, err = self._stash_stripe(wid, seq, num_shards, header,
+                                                arrays)
+                if err is not None:
+                    return err
+                if delta is None:
+                    pending = True  # more stripes to come; no fold yet
+                    staleness = -1
+                else:
+                    staleness = self._fold_locked(wid, seq, pulled, delta)
             else:
-                staleness = self._updates - int(pulled)
-                fold_delta(self._center, arrays, self.discipline, staleness)
-                self.commit_log.append((wid, seq, staleness))
-                self._last_seq[wid] = seq
-                self._updates += 1
+                staleness = self._fold_locked(wid, seq, pulled, arrays)
             updates = self._updates
         if duplicate:
             telemetry.counter("netps.commits_deduped").add(1)
-        else:
+        elif not pending:
             telemetry.counter("netps.commits").add(1)
-        return ({"ok": True, "applied": not duplicate,
-                 "duplicate": duplicate, "updates": updates,
-                 "staleness": staleness}, [])
+        return ({"ok": True, "applied": not (duplicate or pending),
+                 "duplicate": duplicate, "pending": pending,
+                 "updates": updates, "staleness": staleness}, [])
+
+    def _fold_locked(self, wid: int, seq: int, pulled, delta: list) -> int:
+        """The ONE fold (lock held): staleness from the counter rule, then
+        ``fold_delta`` and the exactly-once bookkeeping."""
+        staleness = self._updates - int(pulled)
+        fold_delta(self._center, delta, self.discipline, staleness)
+        self.commit_log.append((wid, seq, staleness))
+        self._last_seq[wid] = seq
+        self._updates += 1
+        self._purge_pending(wid, below_seq=seq)
+        return staleness
+
+    def _stash_stripe(self, wid: int, seq: int, num_shards: int,
+                      header: dict, arrays: list):
+        """Stash one commit stripe (lock held). Returns ``(delta, None)``
+        with the fully assembled tensor list once the LAST stripe lands,
+        ``(None, None)`` while stripes are outstanding, or ``(None, error
+        reply)`` on malformed stripe metadata."""
+        idx = header.get("idx")
+        if idx is None:
+            return None, self._err(
+                "protocol", "striped commit requires stripe indices")
+        try:
+            idx = tuple(int(i) for i in idx)
+        except (TypeError, ValueError):
+            return None, self._err("protocol", f"bad stripe indices {idx!r}")
+        if len(idx) != len(arrays):
+            return None, self._err(
+                "protocol",
+                f"stripe declares {len(idx)} tensors, carries {len(arrays)}")
+        pend = self._pending.setdefault((wid, seq), {})
+        pend[int(header.get("shard", 0))] = (idx, list(arrays))
+        if len(pend) < num_shards:
+            return None, None
+        total = sum(len(ix) for ix, _ in pend.values())
+        delta: list = [None] * total
+        for ix, arrs in pend.values():
+            for i, a in zip(ix, arrs):
+                if not 0 <= i < total or delta[i] is not None:
+                    del self._pending[(wid, seq)]
+                    return None, self._err(
+                        "protocol",
+                        f"inconsistent stripe set for ({wid}, {seq})")
+                delta[i] = a
+        del self._pending[(wid, seq)]
+        if any(d is None for d in delta):
+            return None, self._err(
+                "protocol", f"stripe set for ({wid}, {seq}) has holes")
+        return delta, None
 
     def _op_heartbeat(self, header: dict) -> tuple[dict, list]:
         wid = header.get("worker_id")

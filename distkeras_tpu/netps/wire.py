@@ -10,6 +10,29 @@ buffers`` — array dtype/shape ride in the header (``arrays`` field), the
 buffers follow in order, so a parameter pull is one contiguous write with
 zero pickling.
 
+The data plane is zero-copy on both directions (the PR 4 encode was a
+``b"".join`` triple-copy and the receive a chunk-list + join + per-array
+copy): :func:`send_frame` scatter-gathers the prefix/header and every
+array buffer straight out of their owning arrays via ``socket.sendmsg``
+(crc32 computed incrementally over the same views), and :func:`read_frame`
+reads into ONE preallocated buffer via ``recv_into`` and hands back numpy
+views over it — no intermediate copies anywhere on the RPC hot path. The
+decoded arrays alias that per-frame buffer; they are safe to hold (each
+frame gets a fresh buffer) but mutating them mutates siblings' storage —
+treat them as read-only inputs, copy before long-term mutation (the server
+copies into the center; the fold only reads).
+
+**Per-tensor codecs** (``DKTPU_NET_COMPRESS``): a commit delta's float32
+tensors may ride the wire as ``bf16`` (top-16-bit truncation, 2x smaller)
+or ``int8`` (per-tensor symmetric scale, 4x smaller; the client carries
+the quantization error forward as an error-feedback residual). The wire
+spec for a compressed tensor records the *wire* dtype plus ``codec`` (and
+``scale``) so :func:`decode_frame` transparently dequantizes to float32 —
+the server folds in f32 through the one shared ``netps/fold.py``. Codecs
+are capability-negotiated in the join reply (:data:`CAPS`): a peer that
+never advertises a codec is sent plain f32, so old clients and servers
+interoperate frame-for-frame.
+
 Hardening, in the order an attacker (or the chaos proxy) meets it:
 
 * **magic + version**: a stray client or a mid-stream desync fails in the
@@ -53,23 +76,134 @@ KIND_REPLY = 2
 _PREFIX = struct.Struct("!2sBBII")  # magic, version, kind, crc32, body length
 PREFIX_SIZE = _PREFIX.size
 
+#: sendmsg scatter-gather batch bound (POSIX IOV_MAX is >= 1024 everywhere
+#: this runs; parameter trees deeper than that chunk into several calls).
+_IOV_MAX = 1024
+
+#: delta codecs the wire speaks (``DKTPU_NET_COMPRESS``).
+CODEC_NONE = "none"
+CODEC_BF16 = "bf16"
+CODEC_INT8 = "int8"
+CODECS = (CODEC_NONE, CODEC_BF16, CODEC_INT8)
+
+#: capabilities THIS build advertises in its join reply — the negotiation
+#: surface for every data-plane extension. A peer that never saw this dict
+#: (a PR 4 server) is spoken to in the PR 4 dialect: f32, one connection.
+CAPS = {"codecs": list(CODECS), "striping": True}
+
 
 def max_frame_bytes() -> int:
     return config.env_int("DKTPU_NET_MAX_FRAME")
 
 
-def encode_frame(kind: int, header: dict,
-                 arrays: Sequence[np.ndarray] = ()) -> bytes:
-    """Serialize ``header`` + ``arrays`` into one checksummed frame."""
-    arrays = [np.ascontiguousarray(a) for a in arrays]
+def net_codec() -> str:
+    """The configured delta codec (``DKTPU_NET_COMPRESS``), validated."""
+    codec = config.env_str("DKTPU_NET_COMPRESS")
+    if codec not in CODECS:
+        raise ValueError(
+            f"DKTPU_NET_COMPRESS={codec!r} is not a known codec; "
+            f"known: {list(CODECS)}")
+    return codec
+
+
+# ---------------------------------------------------------------------------
+# Per-tensor codecs
+# ---------------------------------------------------------------------------
+
+def codec_encode(a: np.ndarray, codec: str) -> tuple[np.ndarray, dict]:
+    """``a`` -> ``(wire array, spec extras)`` under ``codec``.
+
+    Only float32 tensors compress (integer/bool tensors and any tensor with
+    a non-finite value — which int8's max-abs scale cannot represent — pass
+    through untouched with empty extras, so mixed trees degrade per-tensor,
+    never per-commit)."""
+    a = np.ascontiguousarray(a)
+    if codec == CODEC_NONE or a.dtype != np.float32 or a.size == 0:
+        return a, {}
+    if codec == CODEC_BF16:
+        # Truncate to the top 16 bits (bf16 has f32's exponent, so this is
+        # exact in range — the mantissa loss is the documented accuracy
+        # trade, docs/PERFORMANCE.md "netps data plane").
+        wire16 = (a.view(np.uint32) >> np.uint32(16)).astype(np.uint16)
+        return wire16, {"codec": CODEC_BF16}
+    if codec == CODEC_INT8:
+        amax = float(np.max(np.abs(a)))
+        if not np.isfinite(amax):
+            return a, {}  # non-finite tensor: ship f32, let the guard see it
+        if amax == 0.0:
+            return np.zeros(a.shape, np.int8), {"codec": CODEC_INT8,
+                                                "scale": 0.0}
+        scale = amax / 127.0
+        q = np.clip(np.rint(a / scale), -127, 127).astype(np.int8)
+        return q, {"codec": CODEC_INT8, "scale": scale}
+    raise ValueError(f"unknown codec {codec!r}")
+
+
+def codec_decode(a: np.ndarray, spec: dict) -> np.ndarray:
+    """Invert :func:`codec_encode` from the wire array + its spec -> f32.
+    Arrays without a ``codec`` key pass through (zero-copy)."""
+    codec = spec.get("codec")
+    if not codec:
+        return a
+    if codec == CODEC_BF16:
+        return (np.ascontiguousarray(a).astype(np.uint32)
+                << np.uint32(16)).view(np.float32)
+    if codec == CODEC_INT8:
+        try:
+            scale = float(spec["scale"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise ProtocolError(f"int8 array spec without a scale: {e}")
+        return a.astype(np.float32) * np.float32(scale)
+    raise ProtocolError(f"unknown codec {codec!r} in array spec")
+
+
+def _normalize_items(arrays) -> list:
+    """``arrays`` items are ``ndarray`` or ``(ndarray, spec_extras)``."""
+    items = []
+    for it in arrays:
+        a, extras = it if isinstance(it, tuple) else (it, {})
+        items.append((np.ascontiguousarray(a), extras))
+    return items
+
+
+def _byte_view(buf) -> memoryview:
+    """A flat, 1-byte-itemsize view of any buffer (arrays included) —
+    what both ``sendmsg`` slicing and incremental crc32 need."""
+    if isinstance(buf, np.ndarray):
+        return memoryview(buf.reshape(-1).view(np.uint8))
+    view = memoryview(buf)
+    if view.ndim != 1 or view.itemsize != 1:
+        view = view.cast("B")
+    return view
+
+
+def _frame_buffers(kind: int, header: dict, arrays) -> tuple[list, int]:
+    """``(buffers, total_bytes)`` for one frame — zero-copy: the returned
+    list holds the packed prefix+header bytes followed by flat views into
+    the caller's arrays; nothing is concatenated."""
+    items = _normalize_items(arrays)
     header = dict(header)
-    header["arrays"] = [{"dtype": a.dtype.str, "shape": list(a.shape)}
-                        for a in arrays]
+    header["arrays"] = [
+        dict({"dtype": a.dtype.str, "shape": list(a.shape)}, **extras)
+        for a, extras in items]
     hjson = json.dumps(header, separators=(",", ":")).encode("utf-8")
-    body = b"".join([struct.pack("!I", len(hjson)), hjson,
-                     *(a.tobytes() for a in arrays)])
-    return _PREFIX.pack(MAGIC, VERSION, kind, zlib.crc32(body),
-                        len(body)) + body
+    views = [_byte_view(a) for a, _ in items]
+    hlen = struct.pack("!I", len(hjson))
+    crc = zlib.crc32(hjson, zlib.crc32(hlen))
+    for v in views:
+        crc = zlib.crc32(v, crc)
+    length = 4 + len(hjson) + sum(v.nbytes for v in views)
+    head = _PREFIX.pack(MAGIC, VERSION, kind, crc, length) + hlen + hjson
+    return [memoryview(head), *views], PREFIX_SIZE + length
+
+
+def encode_frame(kind: int, header: dict,
+                 arrays: Sequence = ()) -> bytes:
+    """Serialize ``header`` + ``arrays`` into one contiguous checksummed
+    frame (tests and the chaos proxy; the RPC hot path sends the same
+    buffers scatter-gather via :func:`send_frame` instead)."""
+    buffers, _total = _frame_buffers(kind, header, arrays)
+    return b"".join(bytes(b) for b in buffers)
 
 
 def parse_prefix(prefix: bytes,
@@ -135,8 +269,13 @@ def _decode_body(body: bytes) -> tuple[dict, list[np.ndarray]]:
                 f"array section truncated: need {n} bytes at offset {off}, "
                 f"body is {len(body)}")
         try:
-            arrays.append(np.frombuffer(body, dtype=dt, count=count,
-                                        offset=off).reshape(shape).copy())
+            # Zero-copy: a view over the frame buffer (each frame owns a
+            # fresh buffer, so views stay valid); codec'd tensors dequantize
+            # to a new f32 array here — the rest of the stack only ever
+            # sees f32.
+            raw_arr = np.frombuffer(body, dtype=dt, count=count,
+                                    offset=off).reshape(shape)
+            arrays.append(codec_decode(raw_arr, spec))
         except ValueError as e:
             raise ProtocolError(f"undecodable array {spec!r}: {e}") from e
         off += n
@@ -146,19 +285,24 @@ def _decode_body(body: bytes) -> tuple[dict, list[np.ndarray]]:
     return header, arrays
 
 
-def recv_exact(sock: socket.socket, n: int) -> bytes:
-    """Read exactly ``n`` bytes or raise: ``ConnectionError`` on EOF,
+def recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    """Fill ``view`` exactly from ``sock`` (``recv_into`` — no chunk list,
+    no join, no copies) or raise: ``ConnectionError`` on EOF,
     ``socket.timeout`` per the socket's timeout (the caller's deadline)."""
-    chunks = []
-    got = 0
+    got, n = 0, len(view)
     while got < n:
-        chunk = sock.recv(min(n - got, 1 << 20))
-        if not chunk:
+        r = sock.recv_into(view[got:])
+        if not r:
             raise ConnectionError(
                 f"connection closed mid-frame ({got}/{n} bytes)")
-        chunks.append(chunk)
-        got += len(chunk)
-    return b"".join(chunks)
+        got += r
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly ``n`` bytes (one preallocated buffer, zero-copy)."""
+    buf = bytearray(n)
+    recv_exact_into(sock, memoryview(buf))
+    return bytes(buf)
 
 
 def finish_raw_frame(sock: socket.socket, prefix: bytes,
@@ -166,6 +310,22 @@ def finish_raw_frame(sock: socket.socket, prefix: bytes,
     """Given an already-received prefix, read the body: whole raw frame."""
     _kind, _crc, length = parse_prefix(prefix, max_frame)
     return prefix + recv_exact(sock, length)
+
+
+def finish_frame(sock: socket.socket, prefix: bytes,
+                 max_frame: Optional[int] = None,
+                 ) -> tuple[int, int, dict, list[np.ndarray]]:
+    """Given an already-received prefix, read + verify + decode the rest
+    zero-copy: ``(kind, total_frame_bytes, header, arrays)`` — the server
+    handler's half of :func:`read_frame` (it polls for the prefix itself
+    so ``close()`` can interrupt it)."""
+    kind, crc, length = parse_prefix(prefix, max_frame)
+    body = bytearray(length)
+    recv_exact_into(sock, memoryview(body))
+    if zlib.crc32(body) != crc:
+        raise ProtocolError("frame checksum mismatch (corrupt or truncated)")
+    header, arrays = _decode_body(body)
+    return kind, PREFIX_SIZE + length, header, arrays
 
 
 def read_raw_frame(sock: socket.socket,
@@ -178,17 +338,51 @@ def read_raw_frame(sock: socket.socket,
 
 def read_frame(sock: socket.socket, max_frame: Optional[int] = None,
                ) -> tuple[int, dict, list[np.ndarray]]:
-    """Read + verify + decode one frame: ``(kind, header, arrays)``."""
-    raw = read_raw_frame(sock, max_frame)
-    return decode_frame(raw)
+    """Read + verify + decode one frame: ``(kind, header, arrays)``.
+
+    Zero-copy: the body lands in ONE preallocated buffer via ``recv_into``
+    and the returned arrays are views over it (codec'd tensors dequantize
+    to fresh f32)."""
+    prefix = recv_exact(sock, PREFIX_SIZE)
+    kind, _nbytes, header, arrays = finish_frame(sock, prefix, max_frame)
+    return kind, header, arrays
 
 
 def send_frame(sock: socket.socket, kind: int, header: dict,
-               arrays: Sequence[np.ndarray] = ()) -> int:
-    """Encode + send one frame; returns bytes written (telemetry)."""
-    frame = encode_frame(kind, header, arrays)
-    sock.sendall(frame)
-    return len(frame)
+               arrays: Sequence = ()) -> int:
+    """Scatter-gather send of one frame (``sendmsg`` straight from the
+    owning array buffers — no ``b"".join``, no ``tobytes``); returns bytes
+    written (telemetry). ``arrays`` items may be ``(array, spec_extras)``
+    tuples for pre-encoded codec tensors."""
+    buffers, total = _frame_buffers(kind, header, arrays)
+    _sendmsg_all(sock, buffers)
+    return total
+
+
+def _sendmsg_all(sock: socket.socket, buffers: list) -> None:
+    """``sendmsg`` the buffer list fully, re-slicing across partial sends
+    and chunking at ``_IOV_MAX``; falls back to per-buffer ``sendall``
+    where the platform has no ``sendmsg``."""
+    if not hasattr(sock, "sendmsg"):  # pragma: no cover - non-POSIX
+        for b in buffers:
+            sock.sendall(b)
+        return
+    # Zero-length views (empty arrays) carry no wire bytes and would spin
+    # the advance loop below (sendmsg over only-empty views returns 0
+    # forever) — drop them up front; the header's shape entry is what
+    # round-trips an empty tensor.
+    views = [v for v in (_byte_view(b) for b in buffers) if v.nbytes]
+    i = 0
+    while i < len(views):
+        sent = sock.sendmsg(views[i:i + _IOV_MAX])
+        while sent:
+            n = views[i].nbytes
+            if sent >= n:
+                sent -= n
+                i += 1
+            else:
+                views[i] = views[i][sent:]
+                sent = 0
 
 
 def split_endpoint(endpoint: str) -> tuple[str, int]:

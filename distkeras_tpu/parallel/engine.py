@@ -128,6 +128,7 @@ class AsyncEngine:
             state_collections=model.state_collections, grad_accum=grad_accum,
             grad_transform=self._grad_transform(),
             input_transform=device_transform,
+            normalize_uint8=getattr(model, "normalize_uint8", True),
         )
         self._multi_fns = {}
         self._round_fn = self._build_round_fn()

@@ -106,6 +106,7 @@ class SyncEngine:
             state_collections=self.model.state_collections,
             grad_accum=self.grad_accum,
             input_transform=self.device_transform,
+            normalize_uint8=getattr(self.model, "normalize_uint8", True),
         )
 
         m = self.workers_per_chip

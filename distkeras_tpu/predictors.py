@@ -56,19 +56,77 @@ def _observe_shards(stream):
         yield out
 
 
-def _unlink_column_files(path: str, physical: str, num_shards: int) -> None:
-    """Best-effort removal of a superseded physical column's shard files.
+def _unlink_column_files(path: str, physical: str, num_shards: int) -> int:
+    """Best-effort removal of a superseded physical column's shard files;
+    returns how many files were actually removed.
 
     Missing files are fine (another process's disk, or already cleaned);
     memmapped readers holding the old manifest survive the unlink (POSIX)."""
-    import contextlib
     import os
 
     from distkeras_tpu.data.shards import _shard_file
 
+    removed = 0
     for s in range(num_shards):
-        with contextlib.suppress(OSError):
+        try:
             os.remove(os.path.join(path, _shard_file(s, physical)))
+            removed += 1
+        except OSError:
+            pass
+    return removed
+
+
+def _publish_manifest(path: str, manifest: dict, tag: str = "") -> None:
+    """Atomic manifest publish (tmp + rename), the ONE write path shared by
+    both predict publishes and :func:`vacuum` — ``tag`` disambiguates the
+    tmp name per process on multi-host stores."""
+    import json
+    import os
+
+    tmp = os.path.join(path, f".manifest.json{tag}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, os.path.join(path, "manifest.json"))
+
+
+def _rotate_garbage(manifest: dict, old_physical: Optional[str]) -> None:
+    """Install this publish's superseded physical column as the manifest's
+    ``garbage`` list (replacing the previous publish's, which the caller
+    reclaims) — the single definition of the deferred-deletion rotation."""
+    if old_physical is not None:
+        manifest["garbage"] = [old_physical]
+    else:
+        manifest.pop("garbage", None)
+
+
+def vacuum(path: str) -> int:
+    """Reclaim superseded prediction columns' shard files NOW.
+
+    Re-predicting an existing column writes a fresh versioned physical
+    column and records the old one under the manifest's ``garbage`` list
+    instead of deleting it (the **reader contract** below). Garbage is
+    normally reclaimed by the NEXT predict run over the same store; call
+    this to reclaim immediately — e.g. between a re-predict and a
+    long-running read-only phase. Returns the number of files removed.
+
+    Reader contract (see :meth:`ModelPredictor._predict_sharded`): a reader
+    that opened the store before a re-predict may keep reading its column
+    files for as long as it holds that manifest — deletion is deferred to
+    the next predict run or an explicit ``vacuum()``, both of which the
+    operator schedules, so "no readers predating the previous publish" is
+    a deployment invariant, not a race."""
+    from distkeras_tpu.data.shards import ShardStore
+
+    store = ShardStore.open(path)
+    garbage = list(store.manifest.get("garbage", []))
+    removed = 0
+    for physical in garbage:
+        removed += _unlink_column_files(path, physical, store.num_shards)
+    if garbage:
+        manifest = dict(store.manifest)
+        _rotate_garbage(manifest, None)
+        _publish_manifest(path, manifest)
+    return removed
 
 
 class ModelPredictor(Predictor):
@@ -86,11 +144,17 @@ class ModelPredictor(Predictor):
         chunk_size: int = 1024,
         num_workers: Optional[int] = None,
         devices=None,
+        normalize_uint8: Optional[bool] = None,
     ):
         self.model = model
         self.features_col = features_col
         self.output_col = output_col
         self.num_workers = num_workers
+        #: uint8 /255 rule: default from the model (training and inference
+        #: must agree on a store's normalization); the kwarg overrides.
+        self.normalize_uint8 = (getattr(model, "normalize_uint8", True)
+                                if normalize_uint8 is None
+                                else bool(normalize_uint8))
         # ``devices``: restrict the forward mesh (the multi-process sharded
         # path passes jax.local_devices() for a collective-free per-host
         # forward). Default: every addressable device.
@@ -104,9 +168,10 @@ class ModelPredictor(Predictor):
         state = self.model.state or {}
         from distkeras_tpu.models.base import normalize_features
 
+        norm = self.normalize_uint8
         self._fwd = jax.jit(
             lambda params, state, x: self.model.module.apply(
-                {"params": params, **state}, normalize_features(x),
+                {"params": params, **state}, normalize_features(x, norm),
                 train=False),
             out_shardings=rep,
         )
@@ -263,7 +328,6 @@ class ModelPredictor(Predictor):
         Rows buffer ACROSS shard boundaries so only the final partial chunk
         is ever padded — per-shard padding would multiply forward FLOPs for
         stores whose shards are smaller than ``chunk_size``."""
-        import json
         import os
 
         import jax
@@ -284,11 +348,19 @@ class ModelPredictor(Predictor):
         # truth for which files a column reads — swaps atomically at the
         # end: a crash mid-stream leaves any pre-existing column fully
         # intact (no per-shard renames over live files, which could mix two
-        # models' outputs). The superseded version's files are deleted after
-        # the swap (memmapped readers of the old manifest survive the
-        # unlink on POSIX; without the cleanup every re-predict leaks one
-        # full column of shard files).
+        # models' outputs).
+        #
+        # READER CONTRACT — deletion of the superseded version is DEFERRED:
+        # its physical name goes on the manifest's ``garbage`` list and its
+        # files stay on disk until the NEXT predict run over this store (or
+        # an explicit ``predictors.vacuum(path)``). A concurrent reader
+        # holding the pre-swap manifest therefore keeps every file it can
+        # name — immediate unlinking raced such readers to FileNotFoundError
+        # on shards they had not memmapped yet (ADVICE r5). Readers that
+        # survive across TWO publishes must re-open the store.
         import uuid
+
+        prior_garbage = list(store.manifest.get("garbage", []))
 
         physical = self.output_col
         old_physical = None
@@ -311,12 +383,12 @@ class ModelPredictor(Predictor):
         if physical != self.output_col:
             colspec["file"] = physical
         manifest["columns"][self.output_col] = colspec
-        tmp = os.path.join(store.path, ".manifest.json.tmp")
-        with open(tmp, "w") as f:
-            json.dump(manifest, f)
-        os.replace(tmp, os.path.join(store.path, "manifest.json"))
-        if old_physical is not None:
-            _unlink_column_files(store.path, old_physical, store.num_shards)
+        _rotate_garbage(manifest, old_physical)
+        _publish_manifest(store.path, manifest)
+        # Reclaim what the PREVIOUS publish deferred (reader contract above);
+        # this run's superseded version waits for the next run or vacuum().
+        for stale in prior_garbage:
+            _unlink_column_files(store.path, stale, store.num_shards)
         return ShardedDataFrame(ShardStore.open(store.path),
                                 num_partitions=sdf.num_partitions)
 
@@ -391,10 +463,11 @@ class ModelPredictor(Predictor):
         after a global barrier (per-process tmp + rename, the
         checkpoint-meta-sidecar pattern: valid on a shared filesystem AND on
         per-host local disks). Re-predicting an existing column writes a
-        fresh versioned physical column; after the publish barrier each
-        process deletes the superseded version's files for its shards
-        (memmapped readers of the old manifest survive the unlink on POSIX)."""
-        import json
+        fresh versioned physical column; the superseded version is NOT
+        deleted — it joins the manifest's ``garbage`` list (the reader
+        contract in :meth:`_predict_sharded` / :func:`vacuum`), and what
+        the PREVIOUS publish deferred is reclaimed after this publish's
+        barrier, each process cleaning what its disk holds."""
         import os
         import uuid
 
@@ -421,9 +494,11 @@ class ModelPredictor(Predictor):
                 np.frombuffer(uuid.uuid4().bytes[:8], dtype=np.uint8))
             physical = f"{self.output_col}.{bytes(bytearray(tag)).hex()[:8]}"
 
+        prior_garbage = list(store.manifest.get("garbage", []))
         local = type(self)(self.model, self.features_col, self.output_col,
                            chunk_size=self.chunk_size,
-                           devices=jax.local_devices())
+                           devices=jax.local_devices(),
+                           normalize_uint8=self.normalize_uint8)
         source = (store.read_shard(s, self.features_col) for s in my_shards)
         for s, out in zip(my_shards,
                           _observe_shards(local.predict_stream(source))):
@@ -440,16 +515,16 @@ class ModelPredictor(Predictor):
         manifest = dict(store.manifest)
         manifest["columns"] = dict(manifest["columns"])
         manifest["columns"][self.output_col] = colspec
-        tmp = os.path.join(store.path, f".manifest.json.p{pid}.tmp")
-        with open(tmp, "w") as f:
-            json.dump(manifest, f)
-        os.replace(tmp, os.path.join(store.path, "manifest.json"))
+        # Every process computes the identical manifest: this publish's
+        # superseded version joins ``garbage`` (deferred deletion — the
+        # reader contract), the previous publish's garbage leaves it.
+        _rotate_garbage(manifest, old_physical)
+        _publish_manifest(store.path, manifest, tag=f".p{pid}")
         multihost_utils.sync_global_devices("dk_sharded_predict_published")
-        if old_physical is not None:
-            # The new manifest is live everywhere: reclaim the superseded
-            # physical column (one full column of shard files per re-predict
-            # otherwise). Each process cleans what its disk holds.
-            _unlink_column_files(store.path, old_physical, store.num_shards)
+        # The new manifest is live everywhere: reclaim what the PREVIOUS
+        # publish deferred. Each process cleans what its disk holds.
+        for stale in prior_garbage:
+            _unlink_column_files(store.path, stale, store.num_shards)
         return ShardedDataFrame(ShardStore.open(store.path),
                                 num_partitions=sdf.num_partitions)
 

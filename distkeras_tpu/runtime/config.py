@@ -144,6 +144,24 @@ ENV_REGISTRY: dict = _declare(
            "Largest wire frame (bytes) either netps side will accept; "
            "oversized frames are rejected before any allocation.",
            "network"),
+    EnvVar("DKTPU_NET_INFLIGHT", "int", 1,
+           "Max un-ACKed netps commits a remote worker may have in flight "
+           "while it computes ahead (compute/comms overlap); 1 = the serial "
+           "pull -> compute -> commit loop. Staleness accounting always "
+           "reflects the realized in-flight delay.",
+           "network"),
+    EnvVar("DKTPU_NET_COMPRESS", "str", "none",
+           "Delta codec for netps commits: `none` (f32), `bf16` (truncate), "
+           "or `int8` (per-tensor scale + client-side error-feedback "
+           "residual). Capability-negotiated at join — a server without the "
+           "codec silently falls back to `none`.",
+           "network"),
+    EnvVar("DKTPU_NET_SHARDS", "int", 1,
+           "Connections a netps client stripes each pull/commit's tensors "
+           "across (concurrent per-shard RPCs, reassembled before "
+           "fold/adopt); 1 = one socket. Negotiated at join; one logical "
+           "commit keeps ONE seq across all stripes (exactly-once).",
+           "network"),
     EnvVar("DKTPU_NET_FAULTS", "str", "",
            "Network-fault chaos plan for the netps proxy and remote worker "
            "loop: `kind@frame[:arg]` entries (`delay`/`drop`/`dup`/"

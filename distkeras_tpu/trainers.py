@@ -118,6 +118,7 @@ class Trainer:
         grad_accum: int = 1,
         transform=None,
         device_transform=None,
+        normalize_uint8: bool = True,
         **kwargs,
     ):
         legacy = {k: kwargs.pop(k) for k in list(kwargs) if k in _LEGACY_SOCKET_KWARGS}
@@ -145,6 +146,13 @@ class Trainer:
                 DeprecationWarning,
                 stacklevel=2,
             )
+        if not normalize_uint8 and getattr(model, "normalize_uint8", True):
+            # The flag lives on the Model (engines, the remote worker loop,
+            # and predictors all read it there — train and inference can
+            # never disagree); the Trainer kwarg is the opt-out surface.
+            import dataclasses as _dc
+
+            model = _dc.replace(model, normalize_uint8=False)
         self.model = model
         self.worker_optimizer = worker_optimizer
         self.loss = loss

@@ -23,6 +23,8 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from distkeras_tpu.models.base import _warn_uint8_rescale
+
 
 def make_local_loop(
     module,
@@ -33,6 +35,7 @@ def make_local_loop(
     state_collections: Sequence[str] = (),
     grad_accum: int = 1,
     input_transform: Optional[Callable] = None,
+    normalize_uint8: bool = True,
 ):
     """Build ``local_steps(params, opt_state, xs, ys, rng, state) ->
     (params, opt_state, state, losses)``.
@@ -89,13 +92,16 @@ def make_local_loop(
         return x
 
     def cast_input(x):
-        if x.dtype == jnp.uint8:
+        if x.dtype == jnp.uint8 and normalize_uint8:
             # Raw image bytes: normalize to the compute dtype ON DEVICE.
             # Shipping uint8 and dividing in-graph is 4x less host->device
             # traffic than staging float32 — the difference between a feed-
             # bound and a compute-bound out-of-core run (docs/PERFORMANCE.md
-            # "Feed overlap"). Unambiguous: integer token/label inputs are
-            # int32/int64, never uint8.
+            # "Feed overlap"). The common case is image bytes (integer
+            # token/label inputs are int32/int64, never uint8), but the rule
+            # is opt-out-able for byte-valued non-image features:
+            # ``normalize_uint8=False`` (threaded from Model/Trainer).
+            _warn_uint8_rescale()
             return x.astype(compute_dtype or jnp.float32) / 255.0
         return cast(x)
 

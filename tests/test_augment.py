@@ -183,3 +183,58 @@ def test_uint8_features_normalized_in_graph():
         # so single-ulp (~1e-9) wobble on ~1e-4 params is expected.
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6,
                                    atol=1e-8)
+
+
+def test_normalize_uint8_opt_out_threads_through_surfaces():
+    """ADVICE r5: byte-valued NON-image features must be able to opt out of
+    the silent /255 rule. The flag lives on the Model and threads through
+    Trainer and ModelPredictor; when the rule DOES fire, it warns once."""
+    import warnings
+
+    import distkeras_tpu as dk
+    from distkeras_tpu.models import base as mbase
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.predictors import ModelPredictor
+
+    rng = np.random.default_rng(0)
+    x8 = rng.integers(0, 4, size=(16, 8)).astype(np.uint8)  # byte categorial
+    opted = Model.build(MLP(hidden=(8,), num_outputs=3),
+                        jnp.zeros((1, 8), jnp.float32),
+                        normalize_uint8=False)
+    # Opted out: the bytes reach the module raw (promotion, no /255).
+    np.testing.assert_allclose(
+        np.asarray(opted.predict(jnp.asarray(x8))),
+        np.asarray(opted.predict(jnp.asarray(x8.astype(np.float32)))),
+        rtol=1e-6)
+    # ModelPredictor inherits the model's flag.
+    p = ModelPredictor(opted)
+    assert p.normalize_uint8 is False
+    out = p.predict(dk.DataFrame({"features": x8}))
+    np.testing.assert_allclose(
+        np.asarray(out["prediction"]),
+        np.asarray(opted.predict(jnp.asarray(x8.astype(np.float32)))),
+        rtol=1e-5, atol=1e-6)
+    # The Trainer kwarg rebinds the model, so engines/remote loop see it.
+    t = dk.ADAG(opted, normalize_uint8=False)
+    assert t.model.normalize_uint8 is False
+    on = Model.build(MLP(hidden=(8,), num_outputs=3),
+                     jnp.zeros((1, 8), jnp.float32))
+    t2 = dk.ADAG(on, normalize_uint8=False)
+    assert t2.model.normalize_uint8 is False and on.normalize_uint8 is True
+    # One-time warning when the rule fires (reset the once-flag for
+    # determinism — other tests may already have tripped it).
+    mbase._uint8_warned[0] = False
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        mbase.normalize_features(np.zeros(3, np.uint8))
+        mbase.normalize_features(np.zeros(3, np.uint8))
+    assert len([w for w in caught
+                if "normalize_uint8" in str(w.message)]) == 1
+    # Opt-out never warns (and never rescales).
+    mbase._uint8_warned[0] = False
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = mbase.normalize_features(np.full(3, 255, np.uint8),
+                                       normalize_uint8=False)
+    assert not caught and out.dtype == np.uint8

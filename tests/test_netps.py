@@ -99,6 +99,223 @@ def test_fold_is_shared_between_raced_and_networked_ps():
 
 
 # ---------------------------------------------------------------------------
+# The fast data plane: codecs, striping, zero-copy frames
+# ---------------------------------------------------------------------------
+
+def test_codec_roundtrip_properties():
+    """bf16 = exact top-16-bit truncation; int8 = per-tensor scale with a
+    bounded one-step error; non-f32 and non-finite tensors pass through."""
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(33, 5)).astype(np.float32)
+    w16, ex = wire.codec_encode(a, "bf16")
+    assert w16.dtype == np.uint16 and ex == {"codec": "bf16"}
+    back = wire.codec_decode(w16, ex)
+    # truncation error <= one bf16 ulp (2^-7 relative), elementwise
+    assert (np.abs(back - a) <= np.abs(a) * 2.0 ** -7 + 1e-9).all()
+    w8, ex8 = wire.codec_encode(a, "int8")
+    assert w8.dtype == np.int8 and ex8["codec"] == "int8"
+    back8 = wire.codec_decode(w8, ex8)
+    assert np.abs(back8 - a).max() <= ex8["scale"] * 0.5 + 1e-7
+    # integer tensors and non-finite tensors ship as-is
+    ints = np.arange(4, dtype=np.int32)
+    assert wire.codec_encode(ints, "int8")[1] == {}
+    bad = np.array([np.nan, 1.0], np.float32)
+    enc, ex = wire.codec_encode(bad, "int8")
+    assert ex == {} and enc.dtype == np.float32
+    # a codec'd frame decodes back to f32 transparently
+    raw = wire.encode_frame(wire.KIND_REQUEST, {"op": "commit"},
+                            [wire.codec_encode(a, "int8")])
+    _k, _h, out = wire.decode_frame(raw)
+    np.testing.assert_allclose(out[0], back8)
+
+
+def test_zero_copy_send_frame_equals_encode_frame():
+    """The sendmsg scatter-gather path must put the byte-identical frame on
+    the wire that encode_frame builds (crc computed incrementally over the
+    same views)."""
+    import socket as _socket
+
+    arrays = [np.arange(10, dtype=np.float32),
+              np.array(3, np.int64),  # 0-d
+              wire.codec_encode(np.ones(7, np.float32), "bf16")]
+    expect = wire.encode_frame(wire.KIND_REQUEST, {"op": "x", "req": 9},
+                               arrays)
+    a, b = _socket.socketpair()
+    try:
+        n = wire.send_frame(a, wire.KIND_REQUEST, {"op": "x", "req": 9},
+                            arrays)
+        assert n == len(expect)
+        got = wire.recv_exact(b, n)
+        assert got == expect
+        # Zero-size leaves carry no wire bytes: sendmsg must skip them
+        # (a trailing empty view used to spin the advance loop forever)
+        # and the decode side rebuilds them from the header's shape.
+        empties = [np.ones(2, np.float32), np.zeros((0, 4), np.float32)]
+        n2 = wire.send_frame(a, wire.KIND_REQUEST, {"op": "y", "req": 10},
+                             empties)
+        k2, h2, out2 = wire.read_frame(b)
+        assert h2["req"] == 10 and out2[1].shape == (0, 4)
+        np.testing.assert_array_equal(out2[0], empties[0])
+        assert n2 == len(wire.encode_frame(
+            wire.KIND_REQUEST, {"op": "y", "req": 10}, empties))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_codec_negotiation_falls_back_on_capability_less_server(monkeypatch):
+    """A PR 4 server never advertises caps: the client must speak the PR 4
+    dialect (f32, one connection) no matter what was requested."""
+    monkeypatch.setattr(wire, "CAPS", {})  # the server replies with this
+    srv = make_server()
+    try:
+        with PSClient(srv.endpoint, worker_id=0, shards=4,
+                      compress="int8", **FAST) as c:
+            init = leaves((8,), (3, 2))
+            c.join(init=init)
+            assert c.codec == "none" and c.active_shards == 1
+            _, upd = c.pull()
+            res = c.commit([np.ones_like(a) for a in init], upd)
+            assert res.applied
+            center, _ = c.pull()
+            np.testing.assert_allclose(center[0], init[0] + 1.0)
+    finally:
+        srv.close()
+
+
+def test_striped_pull_and_commit_match_unsharded():
+    srv = make_server(discipline="downpour")
+    try:
+        init = leaves((40, 3), (7,), (2, 2), (90,))
+        with PSClient(srv.endpoint, worker_id=0, shards=3, **FAST) as c:
+            center, upd = c.join(init=init)
+            assert c.active_shards == 3 and c._stripes is not None
+            # stripes partition the tensor indices exactly
+            flat = sorted(i for s in c._stripes for i in s)
+            assert flat == list(range(len(init)))
+            res = c.commit([np.full_like(a, 2.0) for a in init], upd)
+            assert res.applied and res.staleness == 0
+            striped_center, upd2 = c.pull()
+        with PSClient(srv.endpoint, worker_id=1, **FAST) as plain:
+            plain.join()
+            plain_center, upd3 = plain.pull()
+        assert upd2 == upd3 == 1
+        for a, b, i in zip(striped_center, plain_center, init):
+            np.testing.assert_array_equal(a, b)
+            np.testing.assert_allclose(a, i + 2.0)
+        assert srv.commit_log == [(0, 0, 0)]
+    finally:
+        srv.close()
+
+
+def test_striped_commit_with_dropped_ack_folds_exactly_once():
+    """THE striping acceptance scenario: one logical commit striped over 2
+    connections, one stripe's ACK lost — the retransmitted stripe must be
+    answered by dedup/pending, and the commit folds EXACTLY once."""
+    # frame 0 = join; frames 1,2 = the two commit stripes (either order).
+    srv, px, c = chaos_pair("drop_r@2", timeout=0.4, retries=6, shards=2)
+    try:
+        init = [np.zeros(3, np.float32), np.zeros(5, np.float32)]
+        _, upd = c.join(init=init)
+        assert c.active_shards == 2
+        res = c.commit([np.ones(3, np.float32), np.ones(5, np.float32)], upd)
+        assert res.applied or res.duplicate
+        assert srv.commit_log == [(0, 0, 0)], srv.commit_log
+        np.testing.assert_allclose(srv.center()[0], 1.0)  # folded ONCE
+        np.testing.assert_allclose(srv.center()[1], 1.0)
+        assert not srv._pending  # nothing half-assembled left behind
+    finally:
+        c.close()
+        px.close()
+        srv.close()
+
+
+def test_int8_error_feedback_residual_bounds_drift():
+    """K identical commits under int8: WITH error feedback the accumulated
+    center error stays within one quantization step (the residual carries
+    each round's error into the next), instead of growing linearly."""
+    K = 20
+    base = (np.random.default_rng(3).normal(size=(64,)) * 0.01
+            ).astype(np.float32)
+    srv = make_server(discipline="downpour")
+    try:
+        with PSClient(srv.endpoint, worker_id=0, compress="int8",
+                      **FAST) as c:
+            _, upd = c.join(init=[np.zeros(64, np.float32)])
+            assert c.codec == "int8"
+            for _ in range(K):
+                _, upd = c.pull()
+                c.commit([base], upd)
+            center, _ = c.pull()
+        one_step = float(np.abs(base).max()) / 127.0
+        drift = float(np.abs(center[0] - K * base).max())
+        assert drift <= 1.5 * one_step, (drift, one_step)
+    finally:
+        srv.close()
+
+
+def test_remote_overlap_inflight_trains_and_reports_hidden_fraction(
+        monkeypatch):
+    """DKTPU_NET_INFLIGHT=2 + compression + striping: the double-buffered
+    worker loop converges, stays exactly-once, and exports the overlap
+    hidden-fraction gauge and realized-staleness histogram."""
+    from distkeras_tpu import ADAG, DataFrame, telemetry
+
+    monkeypatch.setenv("DKTPU_NET_TIMEOUT", "2.0")
+    monkeypatch.setenv("DKTPU_NET_INFLIGHT", "2")
+    monkeypatch.setenv("DKTPU_NET_COMPRESS", "int8")
+    monkeypatch.setenv("DKTPU_NET_SHARDS", "2")
+    telemetry.reset()
+    x, y = _blob_data()
+    df = DataFrame({"features": x, "label": y})
+    srv = make_server()
+    try:
+        t = ADAG(_mlp_model(), loss="sparse_categorical_crossentropy",
+                 num_workers=2, batch_size=16, num_epoch=2,
+                 learning_rate=0.1, communication_window=4,
+                 remote=srv.endpoint)
+        trained = t.train(df, shuffle=True)
+        assert _acc(trained, x, y) > 0.85
+        seen = set()
+        for wid, seq, _st in srv.commit_log:
+            assert (wid, seq) not in seen, f"({wid},{seq}) folded twice"
+            seen.add((wid, seq))
+        snap = telemetry.get().snapshot()
+        assert "netps.overlap.hidden_fraction" in snap["gauges"]
+        assert snap["spans"]["netps.commit.staleness"]["count"] > 0
+        assert snap["counters"]["netps.bytes_precompress"] > 0
+        # int8 deltas: commit bytes shrink vs the f32 pre-compression size
+        # (pull replies are still f32, so compare the commit-side counter).
+    finally:
+        srv.close()
+        telemetry.reset()
+
+
+def test_int8_trains_to_parity_with_none(monkeypatch):
+    """Acceptance: the int8+error-feedback path reaches final-accuracy
+    parity with the uncompressed path at the raced-parity tolerance."""
+    from distkeras_tpu import ADAG, DataFrame
+
+    monkeypatch.setenv("DKTPU_NET_TIMEOUT", "2.0")
+    x, y = _blob_data()
+    df = DataFrame({"features": x, "label": y})
+    accs = {}
+    for codec in ("none", "int8"):
+        monkeypatch.setenv("DKTPU_NET_COMPRESS", codec)
+        srv = make_server()
+        try:
+            t = ADAG(_mlp_model(), loss="sparse_categorical_crossentropy",
+                     num_workers=2, batch_size=16, num_epoch=2,
+                     learning_rate=0.1, communication_window=4,
+                     remote=srv.endpoint)
+            accs[codec] = _acc(t.train(df, shuffle=True), x, y)
+        finally:
+            srv.close()
+    assert accs["int8"] > 0.85, accs
+    assert abs(accs["int8"] - accs["none"]) < 0.05, accs
+
+
+# ---------------------------------------------------------------------------
 # Server + client happy path
 # ---------------------------------------------------------------------------
 
@@ -587,9 +804,12 @@ def test_punchcard_ps_launch_rendering():
 def test_netps_chaos_parity_with_raced_ps(monkeypatch):
     """THE acceptance scenario: the same model/data trained (a) through
     netps over loopback with chaos injecting delay/drop/duplicate, a lost
-    commit ACK, and one mid-run worker eviction + rejoin, and (b) through
-    the in-process raced PS — final accuracies agree at the raced-parity
-    tolerance, and the lost-ACK retransmit folded exactly once."""
+    commit ACK, and one mid-run worker eviction + rejoin — with the FULL
+    fast data plane enabled (compute/comms overlap, int8+error-feedback
+    deltas, 2-way striping) — and (b) through the in-process raced PS:
+    final accuracies agree at the raced-parity tolerance, and the lost-ACK
+    retransmit folded exactly once (one logical commit striped over 2
+    connections still folds once)."""
     import test_raced_ps as rp
     from distkeras_tpu import ADAG, DataFrame
     from distkeras_tpu.resilience import faults
@@ -597,6 +817,12 @@ def test_netps_chaos_parity_with_raced_ps(monkeypatch):
     monkeypatch.setenv("DKTPU_NET_TIMEOUT", "1.0")
     monkeypatch.setenv("DKTPU_NET_RETRIES", "8")
     monkeypatch.setenv("DKTPU_NET_BACKOFF", "0.02")
+    # The PR 5 data plane, all knobs on: the hardening guarantees must hold
+    # with overlap + compression + striping active, not only in the PR 4
+    # serial/f32/one-socket dialect.
+    monkeypatch.setenv("DKTPU_NET_INFLIGHT", "2")
+    monkeypatch.setenv("DKTPU_NET_COMPRESS", "int8")
+    monkeypatch.setenv("DKTPU_NET_SHARDS", "2")
     raced_accs, net_accs = [], []
     for seed in (0, 1):
         acc_r, _ = rp._raced_accuracy(seed, "adag")

@@ -675,3 +675,54 @@ def test_virtual_huge_dataset_feeds_from_disk(tmp_path):
     xs, ys = plan.round(0)  # gathers 8 rows = ~4.6 MB, not 42 GiB
     assert xs.shape == (4, 1, 2, h, w, c)
     assert xs[0, 0, 0, 0, 0, 0] == 0.0 and ys.shape == (4, 1, 2)
+
+
+def test_repredict_defers_deletion_and_vacuum_reclaims(tmp_path):
+    """ADVICE r5 reader contract: a re-predict must NOT unlink the
+    superseded physical column (concurrent readers holding the old manifest
+    race to FileNotFoundError) — it goes on the manifest's ``garbage`` list
+    and is reclaimed by the NEXT predict run or an explicit vacuum()."""
+    import os
+
+    from distkeras_tpu import ModelPredictor
+    from distkeras_tpu.data.shards import ShardStore, _shard_file
+    from distkeras_tpu.models import Model
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.predictors import vacuum
+
+    x, y = _blobs(n=64)
+    write_shards(tmp_path, {"features": x, "label": y}, rows_per_shard=32)
+    models = [Model.build(MLP(hidden=(8,), num_outputs=3),
+                          np.zeros((1, 4), np.float32), seed=s)
+              for s in range(3)]
+    s1 = ModelPredictor(models[0]).predict(ShardedDataFrame(tmp_path))
+    old_store = ShardStore.open(str(tmp_path))  # a concurrent reader
+    s2 = ModelPredictor(models[1]).predict(s1)
+    # v1's files ("prediction" physical) are still on disk: the old reader
+    # can gather rows it never memmapped before the swap.
+    v1 = old_store.gather("prediction", np.arange(64))
+    np.testing.assert_allclose(v1, np.asarray(models[0].predict(x)),
+                               rtol=1e-5, atol=1e-6)
+    garbage = s2.store.manifest.get("garbage", [])
+    assert garbage == ["prediction"], garbage
+    # The NEXT predict run reclaims what the previous publish deferred...
+    s3 = ModelPredictor(models[2]).predict(s2)
+    for s in range(s3.store.num_shards):
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), _shard_file(s, "prediction")))
+    # ...and records the new superseded version in its place.
+    garbage3 = s3.store.manifest.get("garbage", [])
+    old_physical = s2.store.columns["prediction"]["file"]
+    assert garbage3 == [old_physical]
+    # vacuum() reclaims immediately and clears the list.
+    removed = vacuum(str(tmp_path))
+    assert removed == s3.store.num_shards
+    fresh = ShardStore.open(str(tmp_path))
+    assert "garbage" not in fresh.manifest
+    for s in range(fresh.num_shards):
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), _shard_file(s, old_physical)))
+    # the live column still reads
+    v3 = fresh.gather("prediction", np.arange(64))
+    np.testing.assert_allclose(v3, np.asarray(models[2].predict(x)),
+                               rtol=1e-5, atol=1e-6)
