@@ -119,6 +119,26 @@ def _prior_values() -> dict[str, float]:
     return {}
 
 
+def _emit_summary(out: dict) -> None:
+    """Emit the bench summary both ways the driver can consume it: as the
+    process's FINAL stdout line (flushed, nothing printed after it — the
+    BENCH_r05 record showed a truncated tail machine-reads as
+    ``"parsed": null``) and as ``BENCH_SUMMARY.json`` beside the repo's
+    other bench artifacts, so a clipped stdout stream still leaves a
+    parseable record on disk."""
+    import sys
+
+    summary = json.dumps(out)
+    try:
+        with open(os.path.join(_REPO, "BENCH_SUMMARY.json"), "w") as f:
+            f.write(summary + "\n")
+    except OSError as e:  # the printed line is still the record of truth
+        print(f"[bench] BENCH_SUMMARY.json write failed: {e}",
+              file=sys.stderr)
+    sys.stderr.flush()
+    print(summary, flush=True)
+
+
 def _time_steps(step_once, warmup: int, timed: int, reps: int = None):
     """Shared timing protocol: warmup, then ``reps`` independent repetitions
     of the ``timed``-call loop, each fenced by device_get (block_until_ready
@@ -445,12 +465,18 @@ def _measure_netps_transformer(name, *, num_layers, d_model, num_heads, d_ff,
       f32 deltas, one connection; the zero-copy framing is unconditional);
     * ``optimized``  — netps with the PR 5 data plane: compute/comms
       overlap (`DKTPU_NET_INFLIGHT=2`), int8 deltas with error feedback,
-      and 2-way sharded striping.
+      and 2-way sharded striping (loopback TCP);
+    * ``shm``        — the PR 5 knobs over the same-host shared-memory
+      ring (`DKTPU_NET_TRANSPORT=shm`): payloads via mmap, doorbell on a
+      UDS — the PR 6 fast path. ``shm_vs_tcp_optimized`` is the headline
+      A/B (acceptance: >= 1.5x).
 
-    The headline value is the optimized path; ``data_plane_ab`` records
-    all three plus the fraction of the in-process gap the optimizations
-    recover. Loopback TCP is the transport either way, so the A/B isolates
-    the data plane itself from model/compile effects."""
+    The headline value is the shm path (the dialect a colocated deployment
+    negotiates); ``data_plane_ab`` records all four plus the recovered
+    gap fractions. ``hier_curve`` adds the fold-throughput-vs-worker-count
+    curve for the flat vs hierarchical (`DKTPU_NET_HIER=1`) topologies:
+    same shm dialect, per-point root-commit and worker-commit rates, so
+    the root-ingress cut is a measured number."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -514,16 +540,17 @@ def _measure_netps_transformer(name, *, num_layers, d_model, num_heads, d_ff,
         model.module, loss_fn, tx,
         compute_dtype=jnp.bfloat16 if on_tpu else None))
 
-    def run_variant(**knobs):
+    def run_variant(transport="tcp", **knobs):
         elapsed = []
         for rep in range(reps + 1):  # rep 0 = warmup (jit compile, sockets)
-            srv = PSServer(discipline="aeasgd").start()
+            srv = PSServer(discipline="aeasgd", transport=transport).start()
             try:
                 t0 = time.perf_counter()
                 run_remote(endpoint=srv.endpoint, model=model, tx=tx,
-                           loss_fn=loss_fn, plan=plan, discipline="aeasgd",
-                           window=window, alpha=alpha,
+                           loss_fn=loss_fn, plan=plan,
+                           discipline="aeasgd", window=window, alpha=alpha,
                            compute_dtype=jnp.bfloat16 if on_tpu else None,
+                           transport=transport,
                            loop_fn=loop_fn, **knobs)
                 if rep:
                     elapsed.append(time.perf_counter() - t0)
@@ -533,23 +560,75 @@ def _measure_netps_transformer(name, *, num_layers, d_model, num_heads, d_ff,
 
     pr4 = run_variant(inflight=1, shards=1, compress="none")
     opt = run_variant(inflight=2, shards=2, compress="int8")
+    # The ring's best knobs differ from TCP's: with payload copies at
+    # memcpy speed, the int8 quantize/dequantize passes (and a second
+    # ring's doorbell) cost more than the bytes they save — f32 over ONE
+    # ring wins (measured; the codec stays a TCP/cross-host lever).
+    shm_v = run_variant(transport="shm", inflight=2, shards=1,
+                        compress="none")
+
+    # -- fold-throughput vs worker count: flat vs hierarchical topology ----
+    # One timed run per point (the executable and sockets are warm from the
+    # variants above): root-commit rate is the ingress the root actually
+    # absorbs; worker-commit rate is the system-wide fold demand — their
+    # ratio is the measured fan-in cut. Deliberately NOT run_variant: each
+    # point needs the server's commit_log and a single unwarmed shot, not
+    # the warmup+reps throughput protocol.
+    curve_rounds = max(4, rounds // 2)
+    hier_curve = []
+    for W in (1, 2, 4):
+        toks_w = rng.integers(0, vocab,
+                              size=(W * batch * window * curve_rounds,
+                                    seq_len))
+        df_w = DataFrame({"features": toks_w.astype(np.int32),
+                          "label": np.roll(toks_w, -1, 1).astype(np.int32)})
+        plan_w = make_batches(df_w, "features", "label", batch_size=batch,
+                              num_workers=W, window=window, num_epoch=1)
+        tokens_w = plan_w.num_rounds * W * window * batch * seq_len
+        for topo in ("flat", "hier"):
+            srv = PSServer(discipline="aeasgd", transport="shm").start()
+            try:
+                t0 = time.perf_counter()
+                run_remote(endpoint=srv.endpoint, model=model, tx=tx,
+                           loss_fn=loss_fn, plan=plan_w,
+                           discipline="aeasgd", window=window, alpha=alpha,
+                           compute_dtype=jnp.bfloat16 if on_tpu else None,
+                           transport="shm", hier=(topo == "hier"),
+                           hier_flush=0.5, inflight=1, shards=1,
+                           compress="none", loop_fn=loop_fn)
+                dt = time.perf_counter() - t0
+                hier_curve.append({
+                    "workers": W, "topology": topo,
+                    "tokens_per_sec": round(tokens_w / dt, 1),
+                    "root_commits": len(srv.commit_log),
+                    "root_commits_per_sec": round(
+                        len(srv.commit_log) / dt, 2),
+                    "worker_commits_per_sec": round(
+                        W * plan_w.num_rounds / dt, 2),
+                })
+            finally:
+                srv.close()
 
     gap = inproc - pr4["value"]
     rec = {
         "metric": f"{name}_tokens_per_sec_per_chip",
-        "value": round(opt["value"], 1), "unit": "tokens/s/chip",
-        "p50": opt["p50"], "p10": opt["p10"], "p90": opt["p90"],
-        "reps": opt["reps"],
+        "value": round(shm_v["value"], 1), "unit": "tokens/s/chip",
+        "p50": shm_v["p50"], "p10": shm_v["p10"], "p90": shm_v["p90"],
+        "reps": shm_v["reps"],
         "data_plane_ab": {
             "inprocess_tokens_per_sec": round(inproc, 1),
             "pr4_tokens_per_sec": round(pr4["value"], 1),
             "optimized_tokens_per_sec": round(opt["value"], 1),
+            "shm_tokens_per_sec": round(shm_v["value"], 1),
             "optimized_vs_pr4": round(opt["value"] / pr4["value"], 3),
+            "shm_vs_tcp_optimized": round(shm_v["value"] / opt["value"], 3),
             "rpc_gap_recovered": (
-                round((opt["value"] - pr4["value"]) / gap, 3)
+                round((shm_v["value"] - pr4["value"]) / gap, 3)
                 if gap > 0 else None),
-            "knobs": {"inflight": 2, "compress": "int8", "shards": 2},
+            "knobs": {"inflight": 2, "compress": "none", "shards": 1,
+                      "transport": "shm"},
         },
+        "hier_curve": hier_curve,
     }
     return rec
 
@@ -725,7 +804,7 @@ def scaling_sweep():
             "predicted_efficiency_at_64": analytic.efficiency(64),
         }
     out["resnet50_sync_v5e"] = resnet_sync_scaling_section()
-    print(json.dumps(out))
+    _emit_summary(out)
 
 
 def resnet_sync_scaling_section() -> dict:
@@ -968,7 +1047,7 @@ def main():
     except Exception as e:  # diagnostics never fail the bench
         print(f"[bench] telemetry dump failed: {e}",
               file=__import__("sys").stderr)
-    print(json.dumps(out))
+    _emit_summary(out)
 
 
 if __name__ == "__main__":

@@ -27,7 +27,15 @@ production edges the reference never had:
 * :mod:`~distkeras_tpu.netps.remote` — the worker loop the async trainers
   run under ``remote="host:port"`` (pull -> K jitted local steps ->
   commit), double-buffered under ``DKTPU_NET_INFLIGHT`` so commits and
-  pull prefetches overlap the next window's compute.
+  pull prefetches overlap the next window's compute;
+* :mod:`~distkeras_tpu.netps.shm` — the same-host fast path
+  (``DKTPU_NET_TRANSPORT=shm``): payloads in an mmap'd seqlock'd ring,
+  doorbell + fd-passing on a Unix socket, negotiated through the caps
+  handshake with a boot-id check (cross-host/old peers stay on TCP);
+* :mod:`~distkeras_tpu.netps.hier` — hierarchical two-level folds
+  (``DKTPU_NET_HIER=1``): :class:`AggregatorServer` pre-combines a host's
+  commits and forwards one combined commit upstream, cutting root ingress
+  by the worker fan-in.
 
 The data plane (compute/comms overlap, compressed deltas, sharded
 striping over ``DKTPU_NET_SHARDS`` connections, zero-copy frames) is
@@ -55,10 +63,12 @@ from distkeras_tpu.netps.fold import (  # noqa: F401
     commit_scale,
     fold_delta,
 )
+from distkeras_tpu.netps.hier import AggregatorServer  # noqa: F401
 from distkeras_tpu.netps.server import PSServer, serve  # noqa: F401
 
 __all__ = [
     "PSServer", "serve", "PSClient", "CommitResult", "ChaosProxy",
+    "AggregatorServer",
     "NetPSError", "ProtocolError", "RPCTimeoutError", "ServerDrainingError",
     "LeaseExpiredError", "ServerClosedError",
     "SUPPORTED_DISCIPLINES", "commit_scale", "fold_delta",

@@ -54,13 +54,14 @@ pool over disjoint connections — that is the supported concurrency).
 from __future__ import annotations
 
 import socket
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
 
-from distkeras_tpu.netps import wire
+from distkeras_tpu.netps import shm, wire
 from distkeras_tpu.netps.errors import (
     LeaseExpiredError,
     NetPSError,
@@ -100,15 +101,22 @@ class CommitResult(NamedTuple):
 
 
 class _Conn:
-    """One TCP connection with its own request-id stream (reply matching
-    is per-connection, so ids need only be unique per stream)."""
+    """One data connection — TCP socket or shared-memory ring — with its
+    own request-id stream (reply matching is per-connection, so ids need
+    only be unique per stream)."""
 
-    __slots__ = ("sock", "req", "ever_connected")
+    __slots__ = ("sock", "ring", "req", "ever_connected", "dialect")
 
     def __init__(self):
         self.sock: Optional[socket.socket] = None
+        self.ring: Optional[shm.ShmConnection] = None
         self.req = 0
         self.ever_connected = False
+        #: last dialect ESTABLISHED on this conn ("tcp"/"shm"/None): only a
+        #: same-dialect re-establishment is failure evidence — a negotiated
+        #: dialect switch (the post-join shm upgrade, a fallback's TCP
+        #: attach) must not read as a flapping network in telemetry.
+        self.dialect: Optional[str] = None
 
 
 class PSClient:
@@ -124,7 +132,8 @@ class PSClient:
                  backoff: Optional[float] = None,
                  auto_rejoin: bool = True,
                  shards: Optional[int] = None,
-                 compress: Optional[str] = None):
+                 compress: Optional[str] = None,
+                 transport: Optional[str] = None):
         self._host, self._port = wire.split_endpoint(endpoint)
         self.endpoint = endpoint
         self.worker_id = worker_id
@@ -144,9 +153,25 @@ class PSClient:
             raise ValueError(f"unknown codec {requested!r}; "
                              f"known: {list(wire.CODECS)}")
         self.requested_codec = requested
+        transport = transport if transport is not None else shm.transport_mode()
+        if transport not in shm.TRANSPORTS:
+            raise ValueError(f"unknown transport {transport!r}; "
+                             f"known: {list(shm.TRANSPORTS)}")
+        #: requested transport dialect (``DKTPU_NET_TRANSPORT``); the ring
+        #: is used only when the join reply advertises a same-boot-id shm
+        #: endpoint — anything else silently stays on TCP.
+        self.transport = transport
         #: negotiated at join; until then the PR 4 dialect (f32, 1 conn).
         self.codec = wire.CODEC_NONE
         self.active_shards = 1
+        #: the server's advertised ring endpoint when the same-host check
+        #: passed (``{"boot_id", "uds"}``), else None (TCP dialect).
+        self.shm_info: Optional[dict] = None
+        #: serializes the shm->TCP fallback sweep: only the stripe thread
+        #: that actually transitions shm_info to None closes the other
+        #: conns — a second sweeper would otherwise close a sibling's
+        #: freshly re-established TCP socket mid-RPC.
+        self._fallback_lock = threading.Lock()
         self.lease_s: Optional[float] = None
         self._conns = [_Conn() for _ in range(self.shards)]
         self._pool: Optional[ThreadPoolExecutor] = None
@@ -180,7 +205,7 @@ class PSClient:
             return conn.sock
         from distkeras_tpu import telemetry
 
-        if conn.ever_connected:
+        if conn.ever_connected and conn.dialect == "tcp":
             telemetry.counter("netps.reconnects").add(1)
         # The connect spends from the SAME per-attempt budget as the send
         # and reply (the documented contract): against a SYN-blackholing
@@ -193,16 +218,58 @@ class PSClient:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         conn.sock = sock
         conn.ever_connected = True
+        conn.dialect = "tcp"
         return sock
+
+    @property
+    def active_transport(self) -> str:
+        """The dialect the data connections speak right now."""
+        return "shm" if self.shm_info is not None else "tcp"
 
     @staticmethod
     def _disconnect(conn: _Conn) -> None:
-        if conn.sock is not None:
+        # Concurrent callers (the shm->TCP fallback sweeps EVERY conn from
+        # whichever stripe thread failed first; siblings disconnect their
+        # own) must never None-deref: snapshot-and-null, then close — a
+        # double close is benign (sock.close and Slot.close are
+        # idempotent), a close-after-null is impossible.
+        sock, conn.sock = conn.sock, None
+        ring, conn.ring = conn.ring, None
+        if sock is not None:
             try:
-                conn.sock.close()
+                sock.close()
             except OSError:
                 pass
-            conn.sock = None
+        if ring is not None:
+            ring.close()
+
+    def _connect_ring(self, conn: _Conn, uds: str,
+                      deadline: float) -> shm.ShmConnection:
+        if conn.ring is not None:
+            return conn.ring
+        from distkeras_tpu import telemetry
+
+        if conn.dialect == "shm":
+            telemetry.counter("netps.reconnects").add(1)
+        elif conn.ever_connected:
+            # Routine post-join TCP->ring upgrade on a healthy run: its own
+            # counter, NOT reconnects (documented as failure evidence).
+            telemetry.counter("netps.shm_upgrades").add(1)
+        # Attach (UDS connect + segment creation + fd passing) spends from
+        # the same per-attempt budget as the doorbell round trip.
+        ring = shm.ShmConnection(uds, deadline - time.monotonic())
+        conn.ring = ring
+        # A sibling's fallback sweep may have run while we attached; its
+        # sweep nulls shm_info BEFORE iterating conns, so re-checking after
+        # publishing the ring guarantees one side closes it — otherwise the
+        # segments + the server's handler thread would outlive the upgrade
+        # (this conn only ever speaks TCP after the sweep).
+        if self.shm_info is None:
+            self._disconnect(conn)
+            raise ConnectionError("shm fallback engaged during ring attach")
+        conn.ever_connected = True
+        conn.dialect = "shm"
+        return ring
 
     def _shard_pool(self) -> ThreadPoolExecutor:
         if self._pool is None:
@@ -221,26 +288,55 @@ class PSClient:
         conn = self._conns[conn_idx]
         attempts = self.retries + 1
         last_exc: Optional[BaseException] = None
-        # Per-shard RPC spans: stripe sub-RPCs are labeled by their shard so
-        # the report can show per-stripe latency skew.
-        label = (f"netps.rpc.{op}.s{header['shard']}"
-                 if "shard" in header else f"netps.rpc.{op}")
-        with telemetry.span(label):
-            for attempt in range(attempts):
-                conn.req += 1
-                req = conn.req
-                hdr = dict(header, op=op, req=req)
-                if self.worker_id is not None:
-                    hdr.setdefault("worker_id", int(self.worker_id))
-                try:
+        for attempt in range(attempts):
+            conn.req += 1
+            req = conn.req
+            hdr = dict(header, op=op, req=req)
+            if self.worker_id is not None:
+                hdr.setdefault("worker_id", int(self.worker_id))
+            # Per-shard RPC spans: stripe sub-RPCs are labeled by their
+            # shard so the report can show per-stripe latency skew. The
+            # transport dialect labels the span too (``.shm``; bare = TCP,
+            # the historical names) so the report CLI can attribute RPC
+            # time per dialect — computed PER ATTEMPT, so the TCP attempts
+            # after a mid-RPC shm fallback are not billed to the ring.
+            dialect = ".shm" if self.shm_info is not None else ""
+            label = (f"netps.rpc.{op}.s{header['shard']}{dialect}"
+                     if "shard" in header else f"netps.rpc.{op}{dialect}")
+            try:
+                with telemetry.span(label):
                     return self._attempt(conn, req, hdr, arrays)
-                except (socket.timeout, ConnectionError, OSError,
-                        ProtocolError) as e:
-                    last_exc = e
-                    self._disconnect(conn)
-                    if attempt + 1 < attempts:
-                        telemetry.counter("netps.retries").add(1)
-                        time.sleep(full_jitter(self.backoff, attempt))
+            except (socket.timeout, ConnectionError, OSError,
+                    ProtocolError) as e:
+                if getattr(e, "from_reply", False):
+                    raise  # the server said no; asking again won't help
+                last_exc = e
+                self._disconnect(conn)
+                if self.shm_info is not None and (
+                        attempt >= 1 or attempt + 1 == attempts):
+                    # Two ring failures in a row (a transient fault retries
+                    # once on the ring) — or the LAST attempt of a smaller
+                    # retry budget, so a retries<=1 client still lands its
+                    # NEXT rpc on TCP instead of riding a dead ring
+                    # forever: the doorbell endpoint is likely gone — fall
+                    # back to TCP, which the server always serves; the next
+                    # join re-negotiates the upgrade. Drop EVERY
+                    # connection's ring (not just this one's): stale
+                    # attachments would otherwise leak segments + a server
+                    # handler thread for the life of the client. Only the
+                    # thread that wins the transition sweeps (a loser's
+                    # sweep would close a sibling's fresh TCP socket).
+                    with self._fallback_lock:
+                        swept = self.shm_info is not None
+                        if swept:
+                            self.shm_info = None
+                            for other in self._conns:
+                                self._disconnect(other)
+                    if swept:
+                        telemetry.counter("netps.shm_fallbacks").add(1)
+                if attempt + 1 < attempts:
+                    telemetry.counter("netps.retries").add(1)
+                    time.sleep(full_jitter(self.backoff, attempt))
         telemetry.counter("netps.rpc_failures").add(1)
         raise RPCTimeoutError(
             f"{op} to {self.endpoint} failed after {attempts} attempts "
@@ -249,21 +345,45 @@ class PSClient:
 
     def _attempt(self, conn: _Conn, req: int, hdr: dict,
                  arrays: Sequence) -> tuple[dict, list]:
-        """One connect + send + matched-reply receive under ONE deadline."""
+        """One connect + send + matched-reply receive under ONE deadline.
+        The transport is whatever the join negotiated: TCP frames, or the
+        same-host ring (payload in shared memory, doorbell on the UDS) —
+        the deadline/matching/error contract is identical either way."""
         from distkeras_tpu import telemetry
 
         deadline = time.monotonic() + self.timeout
-        sock = self._connect(conn, deadline)
-        sock.settimeout(max(0.001, deadline - time.monotonic()))
-        sent = wire.send_frame(sock, wire.KIND_REQUEST, hdr, arrays)
+        # One read: a sibling stripe thread's shm->TCP fallback may null
+        # shm_info at any point; this attempt finishes on the dialect it
+        # started with (a closed ring raises the retryable taxonomy).
+        info = self.shm_info
+        if info is not None:
+            ring = self._connect_ring(conn, info["uds"], deadline)
+            ring.settimeout(max(0.001, deadline - time.monotonic()))
+            sent = ring.send(wire.KIND_REQUEST, hdr, arrays)
+
+            def set_timeout(t):
+                ring.settimeout(t)
+
+            def recv_one():
+                return ring.recv()
+        else:
+            sock = self._connect(conn, deadline)
+            sock.settimeout(max(0.001, deadline - time.monotonic()))
+            sent = wire.send_frame(sock, wire.KIND_REQUEST, hdr, arrays)
+
+            def set_timeout(t):
+                sock.settimeout(t)
+
+            def recv_one():
+                prefix = wire.recv_exact(sock, wire.PREFIX_SIZE)
+                return wire.finish_frame(sock, prefix)
         telemetry.counter("netps.bytes_sent").add(sent)
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise socket.timeout(f"{hdr['op']} deadline exceeded")
-            sock.settimeout(remaining)
-            prefix = wire.recv_exact(sock, wire.PREFIX_SIZE)
-            kind, nbytes, rhdr, rarrays = wire.finish_frame(sock, prefix)
+            set_timeout(remaining)
+            kind, nbytes, rhdr, rarrays = recv_one()
             if kind != wire.KIND_REPLY:
                 raise ProtocolError(f"expected a reply frame, got kind {kind}")
             if rhdr.get("req") != req:
@@ -274,9 +394,15 @@ class PSClient:
             telemetry.counter("netps.bytes_received").add(nbytes)
             err = rhdr.get("error")
             if err:
-                exc = _ERROR_TYPES.get(err, NetPSError)
-                raise exc(f"{hdr['op']}: server said {err}: "
-                          f"{rhdr.get('message', '')}")
+                exc = _ERROR_TYPES.get(err, NetPSError)(
+                    f"{hdr['op']}: server said {err}: "
+                    f"{rhdr.get('message', '')}")
+                # The server ANSWERED — retrying a deterministic rejection
+                # burns the whole budget for the same answer. ProtocolError
+                # is otherwise retryable (a corrupt frame heals on a fresh
+                # connection); this flag tells _rpc the difference.
+                exc.from_reply = True
+                raise exc
             return rhdr, rarrays
 
     # -- striping helpers ---------------------------------------------------
@@ -341,6 +467,23 @@ class PSClient:
                       else wire.CODEC_NONE)
         self.active_shards = self.shards if caps.get("striping") else 1
         self._compute_stripes(center)
+        # Same-host transport upgrade: only when this client asked for shm
+        # AND the server advertised a ring endpoint AND the boot ids match
+        # (actually-the-same-kernel, not just the same hostname). Every
+        # other combination — old server (no caps / boolean bit), cross
+        # host, tcp mode — stays on the TCP dialect with zero behavior
+        # change. A re-join that lands on a different answer (e.g. a
+        # restarted TCP-only server) tears the stale connections down.
+        adv = caps.get("shm")
+        info = (adv if self.transport == "shm" and isinstance(adv, dict)
+                and adv.get("uds") and adv.get("boot_id") == shm.local_boot_id()
+                and shm.endpoint_visible(adv["uds"])
+                else None)
+        with self._fallback_lock:  # vs a concurrent fallback sweep
+            if (info is None) != (self.shm_info is None):
+                for conn in self._conns:
+                    self._disconnect(conn)
+            self.shm_info = info
         # Error feedback restarts on every (re)join: the residual belongs
         # to the window lineage the rejoin just discarded.
         self._residual = None
@@ -352,6 +495,18 @@ class PSClient:
         if server_seq > self._seq:
             self._seq = server_seq
         return center, int(hdr["updates"])
+
+    def adopt_dialect(self, other: "PSClient",
+                      template: Sequence[np.ndarray]) -> None:
+        """Adopt another client's join-negotiated dialect (codec, striping,
+        transport) without a join of our own — membership is by worker_id,
+        not by connection. The overlap loop's pull-prefetch client uses
+        this so both lanes speak the same wire."""
+        self.codec = other.codec
+        self.active_shards = other.active_shards
+        with self._fallback_lock:  # vs a concurrent fallback sweep
+            self.shm_info = other.shm_info
+        self._compute_stripes(template)
 
     def pull(self) -> tuple[list, int]:
         """Current center + update counter; renews the lease. An evicted

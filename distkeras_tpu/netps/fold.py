@@ -14,11 +14,30 @@ disciplines send ``e = α·(w − center)``), and the *server* applies one
 scale — ``1/(staleness+1)`` for DynSGD, identity for everything else — and
 adds. Staleness is the server's update counter minus the committer's
 pull-time counter.
+
+**Compressed-domain folds.** A delta tensor may arrive as an ``(array,
+spec)`` pair in its *wire* dtype (the netps handlers read frames with
+``decode=False``): int8 with a per-tensor scale, or bf16 bit-truncated.
+Those fold without a decode-to-f32 pass — the dequantization is fused
+into the accumulate. Two backends, one dispatch point (here, so parity
+evidence stays transferable):
+
+* a **pure-numpy reference** (CPU CI, and the default for a stdlib-only
+  server process): ``center += (commit_scale · tensor_scale) · q`` in one
+  fused expression;
+* the **Pallas kernel** (``distkeras_tpu.ops.pallas.fold``) when jax sees
+  a TPU — the dequant+accumulate as one VMEM-resident pass per tensor.
+  Interpret-mode parity against the numpy reference is pinned by
+  ``tests/test_pallas_fold.py`` and the CI fold-parity job.
+
+Fold throughput is exported by the netps server as the
+``netps.fold.tensors_per_sec`` gauge (docs/OBSERVABILITY.md) so the
+report CLI can tell a fold-bound server from a wire-bound one.
 """
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -44,10 +63,143 @@ def commit_scale(discipline: str, staleness: int) -> float:
     return 1.0
 
 
-def fold_delta(center: Sequence[np.ndarray], delta: Sequence[np.ndarray],
+def split_entry(entry) -> tuple[np.ndarray, Optional[dict]]:
+    """A delta entry is a plain ndarray (in-process callers) or an
+    ``(array, spec)`` wire pair (the netps raw-decode path)."""
+    if isinstance(entry, tuple):
+        a, spec = entry
+        return a, (spec or None)
+    return entry, None
+
+
+def decode_entry(entry) -> np.ndarray:
+    """One delta entry -> a plain f32-domain array (the non-fold consumers:
+    join inits, the hierarchical aggregator's pre-combine)."""
+    from distkeras_tpu.netps import wire
+
+    a, spec = split_entry(entry)
+    return wire.codec_decode(a, spec) if spec else np.asarray(a)
+
+
+def validate_delta(delta) -> bool:
+    """Up-front spec validation for a commit's wire entries — the rules
+    ``codec_decode`` enforced before the ``decode=False`` path existed
+    (unknown codec, int8 without a scale), applied BEFORE any fold or
+    bookkeeping: a spec that failed mid-:func:`fold_delta` would leave
+    the already-folded prefix tensors in the center with no commit_log
+    entry, and the retransmit would fold them AGAIN. Raises
+    ``ProtocolError``; returns whether any entry folds in the compressed
+    domain (the caller's cue to resolve the accelerator backend)."""
+    from distkeras_tpu.netps import wire
+    from distkeras_tpu.netps.errors import ProtocolError
+
+    compressed = False
+    for entry in delta:
+        _a, spec = split_entry(entry)
+        codec = spec.get("codec") if spec else None
+        if not codec:
+            continue
+        if codec == wire.CODEC_INT8:
+            try:
+                float(spec["scale"])
+            except (KeyError, TypeError, ValueError) as e:
+                raise ProtocolError(f"int8 array spec without a scale: {e}")
+        elif codec != wire.CODEC_BF16:
+            raise ProtocolError(f"unknown codec {codec!r} in array spec")
+        compressed = True
+    return compressed
+
+
+# -- compressed-domain backends ---------------------------------------------
+
+_ACCEL = None
+_ACCEL_RESOLVED = False
+
+
+def _accel():
+    """The on-accelerator fold backend, or None. Resolved once: the Pallas
+    kernel is used only when jax is importable AND a TPU is the default
+    backend — the stdlib-only server process never pays a jax import."""
+    global _ACCEL, _ACCEL_RESOLVED
+    if not _ACCEL_RESOLVED:
+        _ACCEL_RESOLVED = True
+        try:
+            import jax
+
+            if jax.default_backend() == "tpu":
+                from distkeras_tpu.ops.pallas import fold as pallas_fold
+
+                _ACCEL = pallas_fold
+        except Exception:
+            _ACCEL = None
+    return _ACCEL
+
+
+def _reset_accel() -> None:
+    """Forget the resolved backend (tests swap backends per-case)."""
+    global _ACCEL, _ACCEL_RESOLVED
+    _ACCEL = None
+    _ACCEL_RESOLVED = False
+
+
+def resolve_backend():
+    """Resolve (and cache) the compressed-fold backend NOW; returns it (or
+    None). Callers that hold a lock across :func:`fold_delta` must call
+    this first, outside the lock: the first resolution imports jax and
+    initializes its backend — seconds, not microseconds — and every
+    pull/commit/heartbeat (i.e. every lease renewal) queues behind that
+    lock meanwhile. The netps server does this per codec'd commit before
+    taking its center lock; after the first call it is a bool check."""
+    return _accel()
+
+
+def fold_compressed_numpy(center: np.ndarray, a: np.ndarray, spec: dict,
+                          scale: float) -> None:
+    """The pure-numpy reference: accumulate a wire-dtype tensor into the
+    f32 ``center`` in place, dequantization fused into the add. Specs are
+    assumed valid (:func:`validate_delta` runs before any fold): a missing
+    int8 scale raises rather than silently folding zero."""
+    from distkeras_tpu.netps import wire
+
+    codec = spec.get("codec")
+    if codec == wire.CODEC_INT8:
+        s = np.float32(scale * float(spec["scale"]))
+        if s:
+            np.add(center, a.astype(np.float32) * s, out=center)
+        return
+    if codec == wire.CODEC_BF16:
+        # Not compressed-domain in any meaningful sense on CPU (the f32
+        # temp materializes either way) — reuse the ONE bf16 dequant.
+        np.add(center, np.float32(scale) * wire.codec_decode(a, spec),
+               out=center)
+        return
+    raise ValueError(f"unknown codec {codec!r} in delta spec")
+
+
+def _fold_entry(c: np.ndarray, entry, scale: float) -> None:
+    a, spec = split_entry(entry)
+    codec = spec.get("codec") if spec else None
+    if not codec:
+        c += scale * np.asarray(a, c.dtype)
+        return
+    accel = _accel()
+    if accel is not None:
+        c[...] = accel.fold_compressed(c, a, spec, float(scale))
+    else:
+        fold_compressed_numpy(c, np.asarray(a), spec, float(scale))
+
+
+def fold_delta(center: Sequence[np.ndarray], delta: Sequence,
                discipline: str, staleness: int) -> None:
     """Fold one worker-normalized commit into ``center`` **in place** —
-    the body of the reference's ``handle_commit`` under the lock."""
+    the body of the reference's ``handle_commit`` under the lock. Delta
+    entries may be plain arrays or ``(array, spec)`` wire pairs; codec'd
+    pairs fold in the compressed domain.
+
+    Deliberately telemetry-free: callers hold their center lock across
+    this, and metrics must not nest a telemetry lock under it (DK201).
+    The netps server times the call and exports
+    ``netps.fold.tensors_per_sec`` after releasing its lock."""
     scale = commit_scale(discipline, staleness)
     for c, d in zip(center, delta):
-        c += scale * np.asarray(d, c.dtype)
+        _fold_entry(c, d, scale)

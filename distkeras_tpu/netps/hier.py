@@ -1,0 +1,263 @@
+"""Hierarchical two-level folds: a per-host aggregator in front of the root.
+
+Flat topology: W workers -> root, W commits per round at the root's
+ingress. With ``DKTPU_NET_HIER=1`` each host interposes an
+:class:`AggregatorServer` — a real :class:`~distkeras_tpu.netps.server.
+PSServer` facade its workers join exactly like a root (same wire, same
+leases, same dedup, and the shm ring when negotiated: the local hop is
+where the ring pays) — that **pre-combines** its workers' commits and
+forwards ONE combined commit upstream per flush, cutting root ingress by
+the worker fan-in.
+
+Semantics, against the discipline rule:
+
+* Worker-normalized deltas are **additive**: for every scale-1 discipline
+  (downpour/adag/aeasgd/eamsgd) folding ``sum(d_i)`` equals folding each
+  ``d_i`` in turn, so the flat and hierarchical topologies produce the
+  SAME center (tested exactly in ``tests/test_netps_shm.py``).
+* The combined commit's **pull-time counter is the min** of its
+  constituents': the root's counter rule then charges the combined commit
+  the staleness of its *oldest* constituent — the conservative reading of
+  the existing discipline rule, which matters only for DynSGD's
+  ``1/(staleness+1)`` scale (one scale for the combined commit, as for
+  any single commit).
+* The aggregator's local update counter **mirrors the root's lineage**:
+  it only advances when a flush lands and the fresh root center is
+  re-pulled, so worker ``pulled`` counters — and therefore local lease
+  renewals, dedup, and the staleness the workers are charged — are all in
+  root units. Workers' retransmits dedup locally; the aggregator's own
+  commits dedup at the root: exactly-once holds at both levels.
+* A flush whose upstream commit is **evicted** (the aggregator's lease
+  lapsed) loses that combined window — the same semantics as a flat
+  worker's evicted commit — and the aggregator re-adopts the root center;
+  workers keep training against the refreshed lineage.
+
+Flush policy: a combined commit leaves when every current member has
+contributed (fan-in reached) or the accumulation is older than
+``flush_interval`` — whichever comes first. Between flushes the
+aggregator heartbeats upstream so its root lease never lapses while
+workers are slow.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from distkeras_tpu.netps.client import PSClient
+from distkeras_tpu.netps.errors import NetPSError
+from distkeras_tpu.netps.fold import check_discipline, decode_entry
+from distkeras_tpu.netps.server import PSServer
+from distkeras_tpu.runtime import config
+
+#: default seconds an under-fan-in accumulation may age before it is
+#: flushed anyway (a straggler must not hold the whole host's progress).
+_FLUSH_INTERVAL_S = 0.02
+
+
+class AggregatorServer(PSServer):
+    """A per-host pre-combining parameter server (see module docstring).
+
+    ``upstream`` is the root's endpoint; ``init`` seeds an uninitialized
+    root (the aggregator joins upstream as ONE worker and adopts the
+    root's center + counter). Everything a PSServer accepts — discipline,
+    lease, transport (shm ring included) — applies to the local side.
+    """
+
+    def __init__(self, upstream: str,
+                 init: Optional[Sequence[np.ndarray]] = None,
+                 discipline: str = "adag", host: str = "127.0.0.1",
+                 port: int = 0, lease_s: Optional[float] = None,
+                 transport: Optional[str] = None,
+                 flush_interval: float = _FLUSH_INTERVAL_S,
+                 fan_in: Optional[int] = None,
+                 timeout: Optional[float] = None,
+                 retries: Optional[int] = None,
+                 backoff: Optional[float] = None):
+        # Validate BEFORE the upstream join (a bad discipline/transport
+        # must not leak a phantom root membership); the PSClient ctor
+        # validates the transport.
+        check_discipline(discipline)
+        self._up = PSClient(upstream, timeout=timeout, retries=retries,
+                            backoff=backoff, transport=transport)
+        try:
+            center, updates = self._up.join(init=list(init or ()))
+            super().__init__(center=center, discipline=discipline,
+                             host=host, port=port, lease_s=lease_s,
+                             transport=transport)
+        except BaseException:
+            try:
+                self._up.leave()
+            except Exception:  # noqa: BLE001 - best effort on teardown
+                pass
+            self._up.close()
+            raise
+        self._updates = int(updates)  # root-lineage counter, not local
+        self.upstream = upstream
+        self.flush_interval = float(flush_interval)
+        self.fan_in = fan_in
+        #: accumulated (decoded f32) combined delta + its min pull counter.
+        self._acc: Optional[list] = None
+        self._acc_pulled: Optional[int] = None
+        self._acc_count = 0
+        #: DISTINCT contributors to the open window — the fan-in check
+        #: counts members heard from, not commits (an overlapping worker
+        #: can land 2 commits while others landed none).
+        self._acc_members: set = set()
+        self._acc_t0 = 0.0
+        self._flush_cv = threading.Condition(self._lock)
+        self._flusher_thread: Optional[threading.Thread] = None
+        #: combined commits forwarded upstream / worker commits absorbed —
+        #: forwarded/absorbed is the measured root-ingress cut.
+        self.forwarded = 0
+        self.absorbed = 0
+        self.lost_windows = 0
+
+    # ------------------------------------------------------------------
+    def start(self) -> "AggregatorServer":
+        if self._started:
+            return self
+        super().start()
+        t = threading.Thread(target=self._flusher_loop,
+                             name="netps-hier-flush")
+        t.start()
+        self._flusher_thread = t
+        return self
+
+    def close(self) -> None:
+        """Drain local commits, stop the server, then flush the remainder
+        upstream and leave — the root holds every absorbed commit before
+        this returns, except windows lost to an upstream eviction or an
+        upstream outage outlasting the retry budget, which are counted in
+        :attr:`lost_windows` (never silently dropped)."""
+        self.drain()
+        super().close()  # joins handlers: no new local commits past here
+        t = self._flusher_thread
+        if t is not None:
+            t.join()
+        self._flush_once(force=True)  # accounts its own failures
+        try:
+            self._up.leave()
+        except (NetPSError, OSError):
+            pass
+        self._up.close()
+
+    # ------------------------------------------------------------------
+    def _fold_locked(self, wid: int, seq: int, pulled, delta: list) -> int:
+        """Absorb one worker commit (lock held): decode wire-domain
+        entries, add into the combined accumulator, take the min pull
+        counter, and do the usual exactly-once bookkeeping — but do NOT
+        advance the update counter (it mirrors the root lineage) and do
+        NOT touch the center (the root owns it)."""
+        pulled = int(pulled)
+        staleness = self._updates - pulled
+        dec = [np.asarray(decode_entry(e), np.float32) for e in delta]
+        if self._acc is None:
+            self._acc = [a.copy() for a in dec]
+            self._acc_pulled = pulled
+            self._acc_t0 = time.monotonic()
+        else:
+            for acc, a in zip(self._acc, dec):
+                acc += a
+            self._acc_pulled = min(self._acc_pulled, pulled)
+        self._acc_count += 1
+        self._acc_members.add(wid)
+        self.absorbed += 1
+        self.commit_log.append((wid, seq, staleness))
+        self._last_seq[wid] = seq
+        self._purge_pending(wid, below_seq=seq)
+        self._flush_cv.notify_all()
+        return staleness
+
+    # ------------------------------------------------------------------
+    def _take_acc_locked(self, force: bool):
+        fan = self.fan_in if self.fan_in else max(1, len(self._members))
+        age = (time.monotonic() - self._acc_t0) if self._acc_count else 0.0
+        if not self._acc_count:
+            return None
+        if (not force and len(self._acc_members) < fan
+                and age < self.flush_interval):
+            return None
+        taken = (self._acc, self._acc_pulled, self._acc_count,
+                 len(self._acc_members))
+        self._acc = None
+        self._acc_pulled = None
+        self._acc_count = 0
+        self._acc_members = set()
+        return taken
+
+    def _lose_window(self) -> None:
+        from distkeras_tpu import telemetry
+
+        self.lost_windows += 1
+        telemetry.counter("netps.hier.lost_windows").add(1)
+
+    def _flush_once(self, force: bool) -> bool:
+        """Forward the accumulated combined commit upstream (outside the
+        lock) and re-adopt the root's center + counter. Returns whether a
+        flush was attempted. Never raises for upstream failures — each
+        outcome is accounted exactly once: a commit that dies in flight or
+        lands evicted is ONE lost window; a pull failure after a landed
+        commit is NOT a lost window (the fold happened; the re-sync just
+        waits for the next flush)."""
+        from distkeras_tpu import telemetry
+
+        with self._lock:
+            taken = self._take_acc_locked(force)
+        if taken is None:
+            return False
+        acc, pulled, count, members = taken
+        try:
+            res = self._up.commit(acc, pulled)
+        except (NetPSError, OSError):
+            # Past the client's own retry budget: the combined window died
+            # in flight — the flat topology's lost-commit semantics, one
+            # level up.
+            self._lose_window()
+            return True
+        if res.evicted:
+            # The aggregator's root lease lapsed with this window pending:
+            # the combined commit was discarded upstream. The client
+            # already re-joined; fall through to re-adopt.
+            self._lose_window()
+        else:
+            self.forwarded += 1
+            telemetry.counter("netps.hier.combined_commits").add(1)
+            telemetry.counter("netps.hier.worker_commits").add(count)
+            # Distinct contributors, not commit count — an overlapping
+            # worker's double commit must not read as wider fan-in.
+            telemetry.gauge("netps.hier.fan_in").set(float(members))
+        try:
+            center, updates = self._up.pull()
+        except (NetPSError, OSError):
+            return True  # commit already accounted; re-sync next flush
+        with self._lock:
+            self._center = [np.asarray(a, np.float32) for a in center]
+            self._updates = int(updates)
+        return True
+
+    def _flusher_loop(self) -> None:
+        lease = self._up.lease_s or config.env_float("DKTPU_PS_LEASE")
+        # The between-flush heartbeat only fires after a wait returns, so
+        # the wait must never outlast the renewal deadline: a
+        # flush_interval above lease/3 would let the root lease lapse
+        # across an idle stretch and the NEXT combined window land
+        # evicted — a lost window with no fault anywhere.
+        wait_s = self.flush_interval
+        if lease:
+            wait_s = min(wait_s, max(0.001, float(lease) / 3.0))
+        last_rpc = time.monotonic()
+        while not self._stop.is_set():
+            with self._flush_cv:
+                self._flush_cv.wait(wait_s)
+            if self._flush_once(force=False):
+                last_rpc = time.monotonic()
+            elif time.monotonic() - last_rpc > float(lease) / 3.0:
+                try:
+                    self._up.heartbeat()
+                except (NetPSError, OSError):
+                    pass  # lease renewal is best-effort between flushes
+                last_rpc = time.monotonic()

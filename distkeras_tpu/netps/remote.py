@@ -159,6 +159,9 @@ def run_remote(
     inflight: Optional[int] = None,
     shards: Optional[int] = None,
     compress: Optional[str] = None,
+    transport: Optional[str] = None,
+    hier: Optional[bool] = None,
+    hier_flush: Optional[float] = None,
     loop_fn=None,
 ) -> tuple[Any, np.ndarray]:
     """Train ``plan.num_workers`` threads against the PS at ``endpoint``.
@@ -168,8 +171,16 @@ def run_remote(
     commit was discarded (eviction) still carry that worker's local loss;
     NaN marks rounds a worker never ran (it was asleep being evicted).
 
-    ``inflight``/``shards``/``compress`` default from the registry
-    (``DKTPU_NET_INFLIGHT``/``DKTPU_NET_SHARDS``/``DKTPU_NET_COMPRESS``).
+    ``inflight``/``shards``/``compress``/``transport``/``hier`` default
+    from the registry (``DKTPU_NET_INFLIGHT``/``DKTPU_NET_SHARDS``/
+    ``DKTPU_NET_COMPRESS``/``DKTPU_NET_TRANSPORT``/``DKTPU_NET_HIER``).
+
+    With ``hier`` on, a per-host :class:`~distkeras_tpu.netps.hier.
+    AggregatorServer` is interposed: the worker threads join IT (over the
+    shm ring when negotiated — the local hop is exactly where the ring
+    pays), it pre-combines their commits and forwards ONE combined commit
+    per flush to the root at ``endpoint``, cutting root ingress by the
+    worker fan-in. The trained params are still pulled from the ROOT.
 
     The first joiner seeds an uninitialized server with this model's
     params, so a bare ``python -m distkeras_tpu.netps`` server needs no
@@ -199,13 +210,27 @@ def run_remote(
     base_key = jax.random.key(seed)
     meter = _CommsMeter()
     client_kw = dict(timeout=timeout, retries=retries, backoff=backoff,
-                     shards=shards, compress=compress)
+                     shards=shards, compress=compress, transport=transport)
+    hier = (config.env_bool("DKTPU_NET_HIER") if hier is None else bool(hier))
+    agg = None
+    worker_endpoint = endpoint
+    if hier:
+        from distkeras_tpu.netps.hier import AggregatorServer
+
+        # The aggregator seeds the root (joining with this model's params)
+        # and serves the local workers — over the shm ring when negotiated.
+        agg_kw = {} if hier_flush is None else {"flush_interval": hier_flush}
+        agg = AggregatorServer(
+            upstream=endpoint, init=init_leaves, discipline=discipline,
+            transport=transport, timeout=timeout, retries=retries,
+            backoff=backoff, **agg_kw).start()
+        worker_endpoint = agg.endpoint
 
     def unflatten(leaves):
         return jax.tree.unflatten(treedef, [np.asarray(a) for a in leaves])
 
     def work(w: int) -> None:
-        client = PSClient(endpoint, worker_id=w, **client_kw)
+        client = PSClient(worker_endpoint, worker_id=w, **client_kw)
         pull_client: Optional[PSClient] = None
         commit_lane = pull_lane = None
         if inflight > 1:
@@ -220,13 +245,13 @@ def run_remote(
         try:
             center_leaves, counter = client.join(init=init_leaves)
             if inflight > 1:
-                pull_client = PSClient(endpoint, worker_id=client.worker_id,
+                pull_client = PSClient(worker_endpoint,
+                                       worker_id=client.worker_id,
                                        **client_kw)
-                # Striping state without a join: adopt the negotiated
-                # dialect (membership is by worker_id, not by connection).
-                pull_client.codec = client.codec
-                pull_client.active_shards = client.active_shards
-                pull_client._compute_stripes(center_leaves)
+                # Striping/codec/transport state without a join: adopt the
+                # negotiated dialect (membership is by worker_id, not by
+                # connection).
+                pull_client.adopt_dialect(client, center_leaves)
             params = unflatten(center_leaves)
             opt_state = tx.init(params)
             local = params if elastic else None
@@ -345,14 +370,20 @@ def run_remote(
                 pull_client.close()
             client.close()
 
-    with telemetry.span("netps.remote_train"):
-        threads = [threading.Thread(target=work, args=(w,),
-                                    name=f"netps-worker-{w}")
-                   for w in range(W)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+    try:
+        with telemetry.span("netps.remote_train"):
+            threads = [threading.Thread(target=work, args=(w,),
+                                        name=f"netps-worker-{w}")
+                       for w in range(W)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+    finally:
+        if agg is not None:
+            # Flushes any half-accumulated combined commit upstream before
+            # the final pull below reads the root's center.
+            agg.close()
     if inflight > 1:
         # The gauge is OVERLAP evidence; the serial loop hides nothing by
         # construction, so exporting there would just report its absence.
@@ -360,6 +391,6 @@ def run_remote(
     if errors:
         raise errors[0]
     with PSClient(endpoint, timeout=timeout, retries=retries,
-                  backoff=backoff) as observer:
+                  backoff=backoff, transport=transport) as observer:
         final_leaves, _updates = observer.pull()
     return unflatten(final_leaves), losses

@@ -22,22 +22,37 @@ never had:
 
 The fold itself is :func:`distkeras_tpu.netps.fold.fold_delta` — the same
 function the in-process raced twin uses, so raced-parity evidence
-transfers. The server is numpy + stdlib only: it runs as its own process
-(``python -m distkeras_tpu.netps``) with no jax dependency on the hot path.
+transfers. Commit tensors reach it in their *wire* dtype (the handlers
+read frames with ``decode=False``), so int8/bf16 deltas fold in the
+compressed domain — dequantization is fused into the accumulate
+(numpy reference on CPU, the ``ops/pallas/fold.py`` kernel on TPU)
+instead of materializing an f32 copy first. The server is numpy + stdlib
+only: it runs as its own process (``python -m distkeras_tpu.netps``) with
+no jax dependency on the hot path.
+
+Transports: TCP always; with ``DKTPU_NET_TRANSPORT=shm`` (or
+``transport="shm"``) the server additionally serves the same-host
+shared-memory ring dialect (``netps/shm.py``) — a UDS doorbell listener
+advertised in the join reply, with payloads in client-owned mmap'd
+segments. Same handlers, same dispatch, same guarantees.
 """
 
 from __future__ import annotations
 
+import os
 import socket
+import tempfile
 import threading
 import time
 from typing import Optional, Sequence
 
 import numpy as np
 
-from distkeras_tpu.netps import wire
+from distkeras_tpu.netps import shm, wire
 from distkeras_tpu.netps.errors import ProtocolError
-from distkeras_tpu.netps.fold import check_discipline, fold_delta
+from distkeras_tpu.netps.fold import (check_discipline, decode_entry,
+                                      fold_delta, resolve_backend,
+                                      validate_delta)
 from distkeras_tpu.runtime import config
 
 #: handler/accept poll tick: how often blocked threads wake to check stop.
@@ -58,8 +73,14 @@ class PSServer:
 
     def __init__(self, center: Optional[Sequence[np.ndarray]] = None,
                  discipline: str = "adag", host: str = "127.0.0.1",
-                 port: int = 0, lease_s: Optional[float] = None):
+                 port: int = 0, lease_s: Optional[float] = None,
+                 transport: Optional[str] = None):
         self.discipline = check_discipline(discipline)
+        self.transport = (transport if transport is not None
+                          else shm.transport_mode())
+        if self.transport not in shm.TRANSPORTS:
+            raise ValueError(f"unknown transport {self.transport!r}; "
+                             f"known: {list(shm.TRANSPORTS)}")
         self._lock = threading.Lock()
         self._center = (None if center is None
                         else [np.array(a, np.float32) for a in center])
@@ -83,6 +104,9 @@ class PSServer:
         #: applied commits in fold order: (worker_id, seq, staleness) — the
         #: exactly-once evidence the chaos tests assert on.
         self.commit_log: list = []
+        #: (tensors, seconds) of the most recent fold — written under the
+        #: lock, exported as the fold-throughput gauge after release.
+        self._fold_stats = (0, 0.0)
         self.evictions = 0
         self.rejoins = 0
         self._draining = False
@@ -95,6 +119,23 @@ class PSServer:
         self._accept_thread: Optional[threading.Thread] = None
         self._monitor_thread: Optional[threading.Thread] = None
         self._started = False
+        # Same-host ring dialect: a UDS doorbell listener, advertised (with
+        # this host's boot id) in every join reply so colocated clients can
+        # upgrade. TCP remains fully served either way — the ring is an
+        # upgrade, never a requirement.
+        self._boot_id = shm.local_boot_id()
+        self._uds_dir: Optional[str] = None
+        self._uds_path: Optional[str] = None
+        self._uds_listener: Optional[socket.socket] = None
+        self._uds_accept_thread: Optional[threading.Thread] = None
+        if self.transport == "shm":
+            self._uds_dir = tempfile.mkdtemp(prefix="dknetps-")
+            self._uds_path = os.path.join(self._uds_dir, "ring.sock")
+            self._uds_listener = socket.socket(socket.AF_UNIX,
+                                               socket.SOCK_STREAM)
+            self._uds_listener.bind(self._uds_path)
+            self._uds_listener.listen()
+            self._uds_listener.settimeout(_POLL_S)
 
     # ------------------------------------------------------------------
     @property
@@ -129,6 +170,11 @@ class PSServer:
                              name="netps-monitor")
         t.start()
         self._monitor_thread = t
+        if self._uds_listener is not None:
+            t = threading.Thread(target=self._uds_accept_loop,
+                                 name="netps-shm-accept")
+            t.start()
+            self._uds_accept_thread = t
         return self
 
     def drain(self) -> None:
@@ -147,6 +193,8 @@ class PSServer:
         self._stop.set()
         if self._accept_thread is not None:
             self._accept_thread.join()
+        if self._uds_accept_thread is not None:
+            self._uds_accept_thread.join()
         if self._monitor_thread is not None:
             self._monitor_thread.join()
         for t in list(self._threads):
@@ -155,6 +203,18 @@ class PSServer:
             self._listener.close()
         except OSError:
             pass
+        if self._uds_listener is not None:
+            try:
+                self._uds_listener.close()
+            except OSError:
+                pass
+            for path in (self._uds_path, self._uds_dir):
+                try:
+                    if path and os.path.exists(path):
+                        (os.unlink if path == self._uds_path
+                         else os.rmdir)(path)
+                except OSError:
+                    pass
 
     # ------------------------------------------------------------------
     def _accept_loop(self) -> None:
@@ -168,6 +228,20 @@ class PSServer:
             conn.settimeout(_POLL_S)
             t = threading.Thread(target=self._handle, args=(conn,),
                                  name="netps-handler")
+            t.start()
+            self._threads.append(t)
+
+    def _uds_accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._uds_listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed under us
+            conn.settimeout(_POLL_S)
+            t = threading.Thread(target=self._handle_shm, args=(conn,),
+                                 name="netps-shm-handler")
             t.start()
             self._threads.append(t)
 
@@ -210,8 +284,10 @@ class PSServer:
                     conn.settimeout(_FRAME_COMPLETE_S)
                     # Zero-copy: the body lands in one preallocated buffer
                     # and the arrays are views over it (wire.finish_frame).
+                    # decode=False keeps codec'd commit tensors in their
+                    # wire dtype for the compressed-domain fold.
                     kind, nbytes, header, arrays = wire.finish_frame(
-                        conn, prefix)
+                        conn, prefix, decode=False)
                     conn.settimeout(_POLL_S)
                 except (socket.timeout, ConnectionError, OSError):
                     return
@@ -220,19 +296,88 @@ class PSServer:
                     # client reconnects and retries.
                     telemetry.counter("netps.protocol_errors").add(1)
                     return
-                if kind != wire.KIND_REQUEST:
+                try:
+                    served = self._serve_frame(kind, nbytes, header, arrays)
+                except ProtocolError:
+                    # An op-level decode error (a join init with a bad codec
+                    # spec reaches decode_entry only now that frames arrive
+                    # decode=False) is the same contract violation as a bad
+                    # frame: count it and tear down — the shm handler's
+                    # outer guard already treats it this way.
                     telemetry.counter("netps.protocol_errors").add(1)
                     return
-                telemetry.counter("netps.bytes_received").add(nbytes)
-                op = header.get("op", "")
-                with telemetry.span(f"netps.server.{op or 'unknown'}"):
-                    reply, out = self._dispatch(op, header, arrays)
-                reply["req"] = header.get("req")
+                if served is None:
+                    return
+                reply, out = served
                 try:
                     sent = wire.send_frame(conn, wire.KIND_REPLY, reply, out)
                 except (ConnectionError, OSError):
                     return
                 telemetry.counter("netps.bytes_sent").add(sent)
+
+    def _handle_shm(self, conn: socket.socket) -> None:
+        """One ring connection's handler: the same request/reply loop as
+        :meth:`_handle` with the payload in the client's mmap'd segments —
+        the doorbell socket carries only 8-byte frame lengths. A bad ring
+        frame (crc flip, torn slot) is a ProtocolError and tears this
+        connection down, exactly like a corrupt TCP frame: the client
+        reconnects with fresh segments and retransmits under the same seq."""
+        from distkeras_tpu import telemetry
+
+        rings = None
+        with conn:
+            try:
+                conn.settimeout(_FRAME_COMPLETE_S)
+                rings = shm.accept_attach(conn)
+                conn.settimeout(_POLL_S)
+                c2s, s2c = rings
+                while not self._stop.is_set():
+                    try:
+                        raw = wire.recv_exact(conn, wire.SHM_DOORBELL_SIZE)
+                    except socket.timeout:
+                        continue
+                    length = wire.unpack_doorbell(raw)
+                    try:
+                        kind, nbytes, header, arrays = c2s.read_frame(
+                            length, decode=False)
+                    except ProtocolError:
+                        telemetry.counter("netps.protocol_errors").add(1)
+                        return
+                    served = self._serve_frame(kind, nbytes, header, arrays,
+                                               dialect=".shm")
+                    if served is None:
+                        return
+                    reply, out = served
+                    sent = s2c.write_frame(wire.KIND_REPLY, reply, out)
+                    conn.sendall(wire.pack_doorbell(sent))
+                    telemetry.counter("netps.bytes_sent").add(sent)
+            except (socket.timeout, ConnectionError, OSError):
+                return
+            except ProtocolError:
+                telemetry.counter("netps.protocol_errors").add(1)
+                return
+            finally:
+                if rings is not None:
+                    for slot in rings:
+                        slot.close()
+
+    def _serve_frame(self, kind: int, nbytes: int, header: dict,
+                     arrays: list, dialect: str = ""):
+        """The transport-independent middle of a request: validate, count,
+        dispatch under a per-op span (labeled with the transport dialect),
+        and stamp the request-id echo. ``None`` = protocol violation, the
+        caller tears the connection down."""
+        from distkeras_tpu import telemetry
+
+        if kind != wire.KIND_REQUEST:
+            telemetry.counter("netps.protocol_errors").add(1)
+            return None
+        telemetry.counter("netps.bytes_received").add(nbytes)
+        op = header.get("op", "")
+        with telemetry.span(f"netps.server.{op or 'unknown'}{dialect}"):
+            reply, out = self._dispatch(op, header, arrays)
+        reply["req"] = header.get("req")
+        return reply, out
 
     def _dispatch(self, op: str, header: dict,
                   arrays: list) -> tuple[dict, list]:
@@ -267,6 +412,10 @@ class PSServer:
 
         wid = header.get("worker_id")
         rejoin = False
+        # The handler hands arrays over raw (wire dtype + spec, for the
+        # compressed-domain commit fold); join inits are plain tensors, so
+        # decoding here is a per-tensor passthrough.
+        init = [decode_entry(a) for a in arrays]
         with self._lock:
             if self._draining:
                 return self._err("draining", "server is draining")
@@ -274,8 +423,8 @@ class PSServer:
                 wid = (max(self._ever) + 1) if self._ever else 0
             wid = int(wid)
             rejoin = wid in self._ever and wid not in self._members
-            if self._center is None and arrays:
-                self._center = [np.array(a, np.float32) for a in arrays]
+            if self._center is None and init:
+                self._center = [np.array(a, np.float32) for a in init]
             if self._center is None:
                 return self._err(
                     "uninitialized",
@@ -297,10 +446,15 @@ class PSServer:
         # commit of the restarted incarnation forever. ``caps`` is the
         # data-plane negotiation: the client only compresses/stripes what
         # this reply advertises (a capability-less PR 4 reply keeps old
-        # clients on the f32 single-connection dialect).
+        # clients on the f32 single-connection dialect). A server actually
+        # serving a ring replaces the static ``shm`` bit with its doorbell
+        # endpoint + boot id — the client upgrades only on a boot-id match.
+        caps = dict(wire.CAPS)
+        if self._uds_path is not None and "shm" in caps:
+            caps["shm"] = {"boot_id": self._boot_id, "uds": self._uds_path}
         return ({"ok": True, "worker_id": wid, "updates": updates,
                  "lease_s": self.lease_s, "last_seq": last_seq,
-                 "caps": wire.CAPS}, center)
+                 "caps": caps}, center)
 
     def _op_pull(self, header: dict) -> tuple[dict, list]:
         wid = header.get("worker_id")
@@ -340,6 +494,18 @@ class PSServer:
         wid, seq = int(wid), int(seq)
         num_shards = int(header.get("num_shards", 1) or 1)
         duplicate = pending = False
+        # Validate specs BEFORE any bookkeeping or fold: a bad spec that
+        # raised mid-fold under the lock would leave a partially-applied
+        # delta the retransmit then double-folds. A codec'd commit also
+        # resolves the fold backend BEFORE taking the center lock — the
+        # first resolution may import jax / init its backend (seconds),
+        # and every member's lease renewal queues behind that lock.
+        try:
+            if validate_delta(arrays):
+                resolve_backend()
+        except ProtocolError as e:
+            telemetry.counter("netps.protocol_errors").add(1)
+            return self._err("protocol", str(e))
         with self._lock:
             if self._draining:
                 return self._err("draining", "server is draining")
@@ -373,6 +539,10 @@ class PSServer:
             telemetry.counter("netps.commits_deduped").add(1)
         elif not pending:
             telemetry.counter("netps.commits").add(1)
+            n, dt = self._fold_stats
+            if n and dt > 0:
+                telemetry.gauge("netps.fold.tensors_per_sec").set(
+                    round(n / dt, 1))
         return ({"ok": True, "applied": not (duplicate or pending),
                  "duplicate": duplicate, "pending": pending,
                  "updates": updates, "staleness": staleness}, [])
@@ -381,7 +551,9 @@ class PSServer:
         """The ONE fold (lock held): staleness from the counter rule, then
         ``fold_delta`` and the exactly-once bookkeeping."""
         staleness = self._updates - int(pulled)
+        t0 = time.perf_counter()
         fold_delta(self._center, delta, self.discipline, staleness)
+        self._fold_stats = (len(delta), time.perf_counter() - t0)
         self.commit_log.append((wid, seq, staleness))
         self._last_seq[wid] = seq
         self._updates += 1

@@ -89,7 +89,65 @@ CODECS = (CODEC_NONE, CODEC_BF16, CODEC_INT8)
 #: capabilities THIS build advertises in its join reply — the negotiation
 #: surface for every data-plane extension. A peer that never saw this dict
 #: (a PR 4 server) is spoken to in the PR 4 dialect: f32, one connection.
-CAPS = {"codecs": list(CODECS), "striping": True}
+#: ``shm`` is the static "this build speaks the shared-memory ring dialect"
+#: bit; a server actually *serving* a ring replaces it in its join reply
+#: with ``{"boot_id": ..., "uds": ...}`` (see ``netps/shm.py``) and the
+#: client upgrades only when the boot id matches its own — the same-host
+#: check that keeps a cross-host ``DKTPU_NET_TRANSPORT=shm`` on TCP.
+CAPS = {"codecs": list(CODECS), "striping": True, "shm": True}
+
+
+# ---------------------------------------------------------------------------
+# Shared-memory segment layout (the same-host ring dialect)
+# ---------------------------------------------------------------------------
+#
+# One mmap'd file per direction (client->server and server->client), each a
+# single seqlock'd slot sized to the largest frame it has carried::
+#
+#     MAGIC(4) VERSION(4) SEQ(4) CRC32(4) LENGTH(8) RESERVED(8) | frame bytes
+#
+# The payload is a regular wire frame (prefix + body), so every header/codec
+# rule above applies unchanged — only the transport underneath differs. SEQ
+# is the seqlock: the writer bumps it odd before touching the slot and even
+# after; a reader that observes an odd SEQ (or a SEQ change across its copy)
+# has raced a writer and treats the frame as corrupt (ProtocolError — the
+# doorbell protocol makes this unreachable in a healthy pairing, so seeing
+# it means the peer desynced and the connection is dead by contract).
+#
+# CRC32 covers the frame's *header section* (prefix + length-prefixed JSON
+# header — everything that drives allocation and dispatch) and is what the
+# chaos hook ``shm_corrupt`` flips. The array payload is deliberately NOT
+# checksummed on this transport: unlike a socket stream, a coherent mmap on
+# one host has no lossy channel — truncation cannot happen (lengths are
+# checked), interleaving is caught by the seqlock, and skipping the payload
+# crc pass is a large share of the ring's win over loopback TCP (crc32
+# runs at ~1 GB/s; the ring's memcpy at >10). Socket frames keep the
+# full-body crc: chaos can truncate those mid-frame.
+#
+# Strict request/reply alternation per connection means ONE slot per
+# direction suffices; striping opens one ring per stripe connection. The
+# doorbell (a UDS byte stream carrying 8-byte frame lengths) provides the
+# happens-before edge and the timeout surface; the segment fds travel over
+# the same UDS via SCM_RIGHTS at attach, so the files are unlinked before
+# any byte moves.
+
+SHM_MAGIC = 0x444B5348  # 'DKSH'
+SHM_VERSION = 1
+_SHM_SLOT = struct.Struct("!IIIIQQ")  # magic, version, seq, crc32, length, rsvd
+SHM_SLOT_HEADER = _SHM_SLOT.size
+_SHM_DOORBELL = struct.Struct("!Q")  # frame length rung across the UDS
+SHM_DOORBELL_SIZE = _SHM_DOORBELL.size
+
+
+def pack_doorbell(nbytes: int) -> bytes:
+    """The 8-byte doorbell announcing an ``nbytes`` ring frame."""
+    return _SHM_DOORBELL.pack(nbytes)
+
+
+def unpack_doorbell(raw: bytes) -> int:
+    """Frame length out of a received doorbell."""
+    (length,) = _SHM_DOORBELL.unpack(raw)
+    return length
 
 
 def max_frame_bytes() -> int:
@@ -177,10 +235,17 @@ def _byte_view(buf) -> memoryview:
     return view
 
 
-def _frame_buffers(kind: int, header: dict, arrays) -> tuple[list, int]:
+def _frame_buffers(kind: int, header: dict, arrays,
+                   body_crc: bool = True) -> tuple[list, int]:
     """``(buffers, total_bytes)`` for one frame — zero-copy: the returned
     list holds the packed prefix+header bytes followed by flat views into
-    the caller's arrays; nothing is concatenated."""
+    the caller's arrays; nothing is concatenated.
+
+    ``body_crc=False`` checksums only the length-prefixed JSON header, not
+    the array payload — the shm ring's contract (``netps/shm.py``): the
+    payload never crosses a lossy medium there, torn writes are caught by
+    the slot seqlock, and skipping the payload pass is a large share of
+    the ring's win. Socket transports always use the full-body crc."""
     items = _normalize_items(arrays)
     header = dict(header)
     header["arrays"] = [
@@ -190,8 +255,9 @@ def _frame_buffers(kind: int, header: dict, arrays) -> tuple[list, int]:
     views = [_byte_view(a) for a, _ in items]
     hlen = struct.pack("!I", len(hjson))
     crc = zlib.crc32(hjson, zlib.crc32(hlen))
-    for v in views:
-        crc = zlib.crc32(v, crc)
+    if body_crc:
+        for v in views:
+            crc = zlib.crc32(v, crc)
     length = 4 + len(hjson) + sum(v.nbytes for v in views)
     head = _PREFIX.pack(MAGIC, VERSION, kind, crc, length) + hlen + hjson
     return [memoryview(head), *views], PREFIX_SIZE + length
@@ -237,7 +303,12 @@ def decode_frame(raw: bytes) -> tuple[int, dict, list[np.ndarray]]:
     return kind, header, arrays
 
 
-def _decode_body(body: bytes) -> tuple[dict, list[np.ndarray]]:
+def _decode_body(body, decode: bool = True) -> tuple[dict, list]:
+    """``decode=False`` keeps codec'd tensors in their *wire* dtype: every
+    array comes back as an ``(array, spec)`` pair instead of f32 — the
+    server's compressed-domain fold path (``netps/fold.py`` consumes the
+    pairs directly, so int8/bf16 deltas are never materialized as f32
+    before folding). Plain tensors pass through either way."""
     if len(body) < 4:
         raise ProtocolError(f"frame body too short ({len(body)} bytes)")
     (hlen,) = struct.unpack_from("!I", body)
@@ -245,7 +316,9 @@ def _decode_body(body: bytes) -> tuple[dict, list[np.ndarray]]:
         raise ProtocolError(
             f"header length {hlen} exceeds body ({len(body)} bytes)")
     try:
-        header = json.loads(body[4:4 + hlen].decode("utf-8"))
+        # bytes() materializes only the small JSON header — body itself may
+        # be a zero-copy memoryview (the shm read path).
+        header = json.loads(bytes(body[4:4 + hlen]).decode("utf-8"))
     except (UnicodeDecodeError, json.JSONDecodeError) as e:
         raise ProtocolError(f"undecodable frame header: {e}") from e
     arrays: list[np.ndarray] = []
@@ -275,7 +348,8 @@ def _decode_body(body: bytes) -> tuple[dict, list[np.ndarray]]:
             # sees f32.
             raw_arr = np.frombuffer(body, dtype=dt, count=count,
                                     offset=off).reshape(shape)
-            arrays.append(codec_decode(raw_arr, spec))
+            arrays.append(codec_decode(raw_arr, spec) if decode
+                          else (raw_arr, spec))
         except ValueError as e:
             raise ProtocolError(f"undecodable array {spec!r}: {e}") from e
         off += n
@@ -313,18 +387,20 @@ def finish_raw_frame(sock: socket.socket, prefix: bytes,
 
 
 def finish_frame(sock: socket.socket, prefix: bytes,
-                 max_frame: Optional[int] = None,
-                 ) -> tuple[int, int, dict, list[np.ndarray]]:
+                 max_frame: Optional[int] = None, decode: bool = True,
+                 ) -> tuple[int, int, dict, list]:
     """Given an already-received prefix, read + verify + decode the rest
     zero-copy: ``(kind, total_frame_bytes, header, arrays)`` — the server
     handler's half of :func:`read_frame` (it polls for the prefix itself
-    so ``close()`` can interrupt it)."""
+    so ``close()`` can interrupt it). ``decode=False`` returns every array
+    as an ``(array, spec)`` pair in its wire dtype (the compressed-domain
+    fold path)."""
     kind, crc, length = parse_prefix(prefix, max_frame)
     body = bytearray(length)
     recv_exact_into(sock, memoryview(body))
     if zlib.crc32(body) != crc:
         raise ProtocolError("frame checksum mismatch (corrupt or truncated)")
-    header, arrays = _decode_body(body)
+    header, arrays = _decode_body(body, decode=decode)
     return kind, PREFIX_SIZE + length, header, arrays
 
 

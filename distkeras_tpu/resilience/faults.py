@@ -57,13 +57,20 @@ _KINDS = frozenset({
 })
 
 #: network fault kinds (``DKTPU_NET_FAULTS``), consumed by the netps chaos
-#: proxy (``netps/chaos.py``) and the remote worker loop. ``at`` indexes
-#: client->server *frames* for the wire kinds and commit *rounds* for
-#: ``evict``. The ``_r`` variants hit the reply (server->client) direction
-#: of the same frame index — "per direction" fault injection.
+#: proxy (``netps/chaos.py``), the shared-memory ring transport
+#: (``netps/shm.py``), and the remote worker loop. ``at`` indexes
+#: client->server *frames* for the wire kinds (TCP frames through the
+#: proxy; ring frames for the ``shm_*`` kinds — no proxy can sit on a
+#: memory ring, so the transport injects its own faults) and commit
+#: *rounds* for ``evict``. The ``_r`` variants hit the reply
+#: (server->client) direction of the same frame index — "per direction"
+#: fault injection. ``shm_delay@F:S`` holds ring frame F for S seconds;
+#: ``shm_corrupt@F`` flips frame F's slot crc so the server rejects it and
+#: the connection dies (the ring's ``truncate``).
 _NET_KINDS = frozenset({
     "delay", "drop", "dup", "truncate", "partition", "evict",
     "delay_r", "drop_r", "dup_r", "truncate_r",
+    "shm_delay", "shm_corrupt",
 })
 
 

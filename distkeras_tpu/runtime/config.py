@@ -162,11 +162,26 @@ ENV_REGISTRY: dict = _declare(
            "fold/adopt); 1 = one socket. Negotiated at join; one logical "
            "commit keeps ONE seq across all stripes (exactly-once).",
            "network"),
+    EnvVar("DKTPU_NET_TRANSPORT", "str", "tcp",
+           "netps wire dialect: `tcp` (default), or `shm` — colocated "
+           "peers (boot-id match, negotiated in the join reply) move "
+           "payloads through a shared-memory ring with a UDS doorbell; "
+           "old peers and cross-host pairs silently stay on TCP with "
+           "every guarantee intact.",
+           "network"),
+    EnvVar("DKTPU_NET_HIER", "bool", False,
+           "Hierarchical two-level folds: each `run_remote` host "
+           "interposes a per-host aggregator that pre-combines its "
+           "workers' commits and forwards one combined commit upstream, "
+           "cutting root ingress by the worker fan-in (combined commit's "
+           "pull counter = min of constituents).",
+           "network"),
     EnvVar("DKTPU_NET_FAULTS", "str", "",
-           "Network-fault chaos plan for the netps proxy and remote worker "
-           "loop: `kind@frame[:arg]` entries (`delay`/`drop`/`dup`/"
-           "`truncate`/`partition`/`evict`, `_r` suffix = reply direction) "
-           "separated by `;`, e.g. `delay@3:0.2;drop@5;partition@7:2`. "
+           "Network-fault chaos plan for the netps proxy, shm ring, and "
+           "remote worker loop: `kind@frame[:arg]` entries (`delay`/`drop`/"
+           "`dup`/`truncate`/`partition`/`evict`, `_r` suffix = reply "
+           "direction; `shm_delay`/`shm_corrupt` hit the shared-memory "
+           "ring) separated by `;`, e.g. `delay@3:0.2;drop@5;partition@7:2`. "
            "Empty = no injection. See docs/RESILIENCE.md.",
            "network"),
     EnvVar("DKTPU_PS_LEASE", "float", 10.0,

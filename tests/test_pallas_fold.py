@@ -1,0 +1,86 @@
+"""Dequant-fused fold kernel parity: Pallas (interpret on CPU CI, compiled
+on TPU) against the pure-numpy reference in ``netps/fold.py`` — the CI
+fold-parity gate for the compressed-domain server fold."""
+
+import jax
+import numpy as np
+import pytest
+
+from distkeras_tpu.netps import fold as netfold
+from distkeras_tpu.netps import wire
+from distkeras_tpu.ops.pallas import fold as pfold
+
+INTERPRET = jax.default_backend() != "tpu"
+
+
+@pytest.mark.parametrize("codec", ["int8", "bf16"])
+@pytest.mark.parametrize("shape", [(7,), (128,), (33, 5), (257, 129),
+                                   (2, 3, 64),
+                                   # > one 512-row block and NOT divisible
+                                   # by it: exercises the multi-block grid
+                                   # padding (a whole-tensor block would
+                                   # blow VMEM on chip)
+                                   (70_001,)])
+@pytest.mark.parametrize("scale", [1.0, 0.5, 1.0 / 3.0])
+def test_kernel_matches_numpy_reference(codec, shape, scale):
+    rng = np.random.default_rng(hash((codec, shape, scale)) % 2**31)
+    d = (rng.normal(size=shape) * 0.01).astype(np.float32)
+    center = rng.normal(size=shape).astype(np.float32)
+    enc, spec = wire.codec_encode(d, codec)
+    assert spec.get("codec") == codec
+    ref = center.copy()
+    netfold.fold_compressed_numpy(ref, enc, spec, scale)
+    out = pfold.fold_compressed(center, enc, spec, scale,
+                                interpret=INTERPRET)
+    assert out.shape == center.shape and out.dtype == np.float32
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-7)
+
+
+def test_kernel_matches_decode_then_fold_within_quant_step():
+    """The acceptance bound: fused dequant-fold vs decode-then-fold agree
+    within one int8 quantization step (associativity of the two scale
+    multiplies is the only difference)."""
+    rng = np.random.default_rng(0)
+    d = (rng.normal(size=(513,)) * 0.02).astype(np.float32)
+    center = rng.normal(size=(513,)).astype(np.float32)
+    enc, spec = wire.codec_encode(d, "int8")
+    decode_then_fold = center + 1.0 * wire.codec_decode(enc, spec)
+    fused = pfold.fold_compressed(center, enc, spec, 1.0,
+                                  interpret=INTERPRET)
+    one_step = float(spec["scale"])
+    assert np.abs(fused - decode_then_fold).max() <= one_step
+
+
+def test_zero_scale_and_empty_edges():
+    enc, spec = wire.codec_encode(np.zeros((4,), np.float32), "int8")
+    assert spec["scale"] == 0.0
+    c = np.ones(4, np.float32)
+    out = pfold.fold_compressed(c, enc, spec, 1.0, interpret=INTERPRET)
+    np.testing.assert_array_equal(out, c)
+    empty = np.zeros((0,), np.float32)
+    assert wire.codec_encode(empty, "bf16")[1] == {}  # empty: passthrough
+    # ...so build the spec by hand to exercise the kernel's empty guard.
+    out_e = pfold.fold_compressed(empty, np.zeros((0,), np.uint16),
+                                  {"codec": "bf16"}, 1.0,
+                                  interpret=INTERPRET)
+    assert out_e.size == 0
+
+
+def test_unknown_codec_is_typed():
+    with pytest.raises(ValueError, match="codec"):
+        pfold.fold_compressed(np.ones(4, np.float32),
+                              np.ones(4, np.int8), {"codec": "zstd"}, 1.0,
+                              interpret=INTERPRET)
+
+
+def test_missing_int8_scale_raises_in_both_backends():
+    """Backend parity on bad input too: a scale-less int8 spec raises in
+    the kernel dispatch exactly like the numpy oracle — neither may
+    silently fold zero while the other raises."""
+    c = np.ones(4, np.float32)
+    q = np.ones(4, np.int8)
+    with pytest.raises(KeyError):
+        pfold.fold_compressed(c, q, {"codec": "int8"}, 1.0,
+                              interpret=INTERPRET)
+    with pytest.raises(KeyError):
+        netfold.fold_compressed_numpy(c.copy(), q, {"codec": "int8"}, 1.0)

@@ -175,3 +175,28 @@ def test_prior_values_skips_driver_record_with_null_parsed(tmp_path,
     for p in tmp_path.glob("BENCH_r0*.json"):
         p.write_text(json.dumps({"parsed": None}))
     assert bench._prior_values() == {}
+
+
+def test_emit_summary_is_final_stdout_line_and_on_disk(tmp_path):
+    """The driver machine-reads the LAST stdout line (BENCH_r05 landed
+    ``"parsed": null`` when the tail was truncated): _emit_summary must
+    print the summary as its own flushed final line AND leave the same
+    JSON in BENCH_SUMMARY.json so a clipped stream still has a record."""
+    import json
+    import subprocess
+    import sys
+
+    code = (
+        "import sys; sys.path.insert(0, sys.argv[2])\n"
+        "import bench\n"
+        "bench._REPO = sys.argv[1]\n"
+        "print('preamble noise')\n"
+        "bench._emit_summary({'metric': 'm', 'value': 1.5, 'configs': []})\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code, str(tmp_path), _REPO],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    final = json.loads(r.stdout.strip().splitlines()[-1])
+    assert final == {"metric": "m", "value": 1.5, "configs": []}
+    with open(tmp_path / "BENCH_SUMMARY.json") as f:
+        assert json.load(f) == final
