@@ -28,6 +28,7 @@ import glob
 import json
 import os
 import re
+import tempfile
 import time
 
 import numpy as np
@@ -540,10 +541,11 @@ def _measure_netps_transformer(name, *, num_layers, d_model, num_heads, d_ff,
         model.module, loss_fn, tx,
         compute_dtype=jnp.bfloat16 if on_tpu else None))
 
-    def run_variant(transport="tcp", **knobs):
+    def run_variant(transport="tcp", state_dir=None, **knobs):
         elapsed = []
         for rep in range(reps + 1):  # rep 0 = warmup (jit compile, sockets)
-            srv = PSServer(discipline="aeasgd", transport=transport).start()
+            srv = PSServer(discipline="aeasgd", transport=transport,
+                           state_dir=state_dir).start()
             try:
                 t0 = time.perf_counter()
                 run_remote(endpoint=srv.endpoint, model=model, tx=tx,
@@ -560,6 +562,63 @@ def _measure_netps_transformer(name, *, num_layers, d_model, num_heads, d_ff,
 
     pr4 = run_variant(inflight=1, shards=1, compress="none")
     opt = run_variant(inflight=2, shards=2, compress="int8")
+    # Durability A/B (write-ahead journal + snapshots, PR 7) on the
+    # OPTIMIZED loopback plane (int8 + overlap + striping — the config a
+    # loopback deployment actually ships): the journal records deltas in
+    # their WIRE dtype, so compressing the wire compresses the journal
+    # 4x, and the overlap lane keeps the (already-async) journal writer
+    # entirely off the compute path — that combination is what holds the
+    # <= 5 % steady-state budget (f32/serial journaling on a CPU dev box
+    # is memory-bandwidth-bound and measures 20-35 %; the knob note in
+    # PERFORMANCE.md). Measured as INTERLEAVED baseline/durable pairs
+    # (back to back, per-pair ratio, median): run-to-run noise between
+    # two minutes-apart measurements here is far larger than the 5 %
+    # being measured, pairing cancels it. A fresh state dir per pair at
+    # the production snapshot cadence; the server is ctor-seeded so the
+    # one-off base snapshot lands before the timed window (steady-state
+    # write path, not a recovery replay or the seed).
+    init_leaves = [np.asarray(a, np.float32)
+                   for a in jax.tree.leaves(model.params)]
+
+    def one_durability_pair(durable_first):
+        import shutil
+
+        out, state = {}, tempfile.mkdtemp(prefix="dkbench-ps-")
+        order = (state, None) if durable_first else (None, state)
+        try:
+            for state_dir in order:
+                srv = PSServer(center=init_leaves if state_dir else None,
+                               discipline="aeasgd",
+                               state_dir=state_dir).start()
+                try:
+                    t0 = time.perf_counter()
+                    run_remote(endpoint=srv.endpoint, model=model, tx=tx,
+                               loss_fn=loss_fn, plan=plan,
+                               discipline="aeasgd", window=window,
+                               alpha=alpha,
+                               compute_dtype=(jnp.bfloat16 if on_tpu
+                                              else None),
+                               inflight=2, shards=2, compress="int8",
+                               loop_fn=loop_fn)
+                    out[state_dir is not None] = time.perf_counter() - t0
+                finally:
+                    srv.close()
+        finally:
+            # Unlinking drops the pair's dirty pages with it: on this box
+            # letting state dirs accumulate makes LATER pairs pay earlier
+            # pairs' writeback — an artifact of back-to-back bench runs,
+            # not of the 20 MB/s a real int8 journal sustains.
+            shutil.rmtree(state, ignore_errors=True)
+        return out[True] / out[False]
+
+    # ABBA: alternate which leg runs first so slow monotonic box drift
+    # (thermal, cache state) cancels instead of biasing the second leg;
+    # geomean over the pairs because the residual noise is symmetric and
+    # multiplicative (an even-N median would arbitrarily pick a side of
+    # a wide gap).
+    ratios = sorted(one_durability_pair(durable_first=bool(i % 2))
+                    for i in range(max(reps + 2, 10)))
+    durable_ratio = float(np.exp(np.mean(np.log(ratios))))
     # The ring's best knobs differ from TCP's: with payload copies at
     # memcpy speed, the int8 quantize/dequantize passes (and a second
     # ring's doorbell) cost more than the bytes they save — f32 over ONE
@@ -622,6 +681,10 @@ def _measure_netps_transformer(name, *, num_layers, d_model, num_heads, d_ff,
             "shm_tokens_per_sec": round(shm_v["value"], 1),
             "optimized_vs_pr4": round(opt["value"] / pr4["value"], 3),
             "shm_vs_tcp_optimized": round(shm_v["value"] / opt["value"], 3),
+            "durable_tokens_per_sec": round(
+                opt["value"] / durable_ratio, 1),
+            "durable_overhead_vs_optimized": round(durable_ratio - 1.0, 3),
+            "durable_pair_ratios": [round(r, 3) for r in ratios],
             "rpc_gap_recovered": (
                 round((shm_v["value"] - pr4["value"]) / gap, 3)
                 if gap > 0 else None),

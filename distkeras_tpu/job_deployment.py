@@ -36,6 +36,16 @@ class Punchcard:
     ``python -m distkeras_tpu.netps`` on that host first and hands every
     worker the endpoint via ``DKTPU_PS_ENDPOINT``, so trainers constructed
     without an explicit ``remote=`` pick it up automatically.
+
+    Durability/failover keys (all optional): ``state_dir`` gives the
+    primary a durable journal+snapshot directory (``--state-dir``) so
+    :meth:`Job.supervise` can cold-restart a dead PS with its center,
+    counter, and dedup state intact; ``standby_host``/``standby_port``
+    (port defaults to primary port + 1) additionally launch a warm
+    standby (``--standby``) that tails the primary's journal and promotes
+    when its lease lapses — the workers' ``DKTPU_PS_ENDPOINT`` then
+    carries the comma-separated ``primary,standby`` list their hardened
+    clients walk on failure.
     """
 
     job_name: str
@@ -47,12 +57,24 @@ class Punchcard:
     ps: Optional[dict] = None
 
     def ps_endpoint(self) -> Optional[str]:
-        """``host:port`` of the parameter server, None when ``ps`` unset."""
+        """Endpoint(s) of the parameter server, None when ``ps`` unset:
+        ``host:port``, or the ``primary,standby`` failover list when a
+        standby is configured (the order the clients walk)."""
         if self.ps is None:
             return None
         host = self.ps.get("host") or self.hosts[0]
         port = int(self.ps.get("port", 7077))
-        return f"{host}:{port}"
+        primary = f"{host}:{port}"
+        standby = self.ps_standby_endpoint()
+        return f"{primary},{standby}" if standby else primary
+
+    def ps_standby_endpoint(self) -> Optional[str]:
+        """``host:port`` of the warm standby, None when not configured."""
+        if self.ps is None or not self.ps.get("standby_host"):
+            return None
+        port = int(self.ps.get("standby_port",
+                               int(self.ps.get("port", 7077)) + 1))
+        return f"{self.ps['standby_host']}:{port}"
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)
@@ -80,8 +102,16 @@ class Job:
         #: the parameter-server process (punchcards with ``ps``), launched
         #: before the workers and torn down with them.
         self._ps_proc: Optional[subprocess.Popen] = None
+        #: the warm-standby process (punchcards with a ``standby_host``).
+        self._standby_proc: Optional[subprocess.Popen] = None
         #: restarts performed per host by :meth:`supervise`.
         self.restarts: list[int] = []
+        #: PS-pair restarts performed by :meth:`supervise` (cold restarts
+        #: from the state dir — the reason ``ps["state_dir"]`` exists);
+        #: the per-role budgets live in :attr:`_ps_role_restarts` so a
+        #: flapping standby cannot drain the primary's budget.
+        self.ps_restarts = 0
+        self._ps_role_restarts: dict = {}
 
     def render_commands(self) -> list[str]:
         """One command line per host, with the jax.distributed bootstrap env
@@ -114,6 +144,33 @@ class Job:
                f"--discipline {shlex.quote(pc.ps.get('discipline', 'adag'))}")
         if pc.ps.get("lease") is not None:
             cmd += f" --lease {float(pc.ps['lease'])}"
+        if pc.ps.get("state_dir"):
+            cmd += f" --state-dir {shlex.quote(pc.ps['state_dir'])}"
+        if pc.ps.get("snapshot_every") is not None:
+            cmd += f" --snapshot-every {int(pc.ps['snapshot_every'])}"
+        return cmd
+
+    def render_standby_command(self) -> Optional[str]:
+        """The warm-standby launch line (None when no standby configured).
+        The standby journals into ``<state_dir>.standby`` so a promoted-
+        then-restarted standby recovers fenced-forward without ever
+        sharing a directory with the primary."""
+        pc = self.punchcard
+        standby = pc.ps_standby_endpoint()
+        if standby is None:
+            return None
+        primary = pc.ps_endpoint().split(",", 1)[0]
+        port = int(standby.rsplit(":", 1)[1])
+        cmd = (f"python -m distkeras_tpu.netps --host 0.0.0.0 "
+               f"--port {port} --standby {shlex.quote(primary)} "
+               f"--discipline {shlex.quote(pc.ps.get('discipline', 'adag'))}")
+        if pc.ps.get("lease") is not None:
+            cmd += f" --lease {float(pc.ps['lease'])}"
+        if pc.ps.get("state_dir"):
+            cmd += (" --state-dir "
+                    + shlex.quote(pc.ps["state_dir"] + ".standby"))
+        if pc.ps.get("snapshot_every") is not None:
+            cmd += f" --snapshot-every {int(pc.ps['snapshot_every'])}"
         return cmd
 
     def _spawn(self, i: int) -> subprocess.Popen:
@@ -148,6 +205,10 @@ class Job:
             ps_host = (self.punchcard.ps.get("host")
                        or self.punchcard.hosts[0])
             self._ps_proc = self._spawn_cmd(ps_host, ps_cmd)
+        standby_cmd = self.render_standby_command()
+        if standby_cmd is not None and self._standby_proc is None:
+            self._standby_proc = self._spawn_cmd(
+                self.punchcard.ps["standby_host"], standby_cmd)
         self._cmds = cmds
         self.restarts = [0] * len(cmds)
         for i in range(len(cmds)):
@@ -176,22 +237,22 @@ class Job:
         return rcs
 
     def _stop_ps(self, grace: float = 5.0) -> None:
-        """Drain the parameter server once the workers are done: SIGTERM
-        triggers its graceful drain; SIGKILL only if it won't."""
-        p = self._ps_proc
-        if p is None or p.poll() is not None:
-            return
-        try:
-            p.terminate()
-        except OSError:
-            return
-        try:
-            p.wait(timeout=grace)
-        except subprocess.TimeoutExpired:
+        """Drain the parameter-server pair once the workers are done:
+        SIGTERM triggers the graceful drain; SIGKILL only if it won't."""
+        for p in (self._ps_proc, self._standby_proc):
+            if p is None or p.poll() is not None:
+                continue
             try:
-                p.kill()
+                p.terminate()
             except OSError:
-                pass
+                continue
+            try:
+                p.wait(timeout=grace)
+            except subprocess.TimeoutExpired:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
 
     def poll(self) -> list:
         """Exit codes so far: one entry per host, ``None`` while running."""
@@ -217,6 +278,7 @@ class Job:
         deadline = time.monotonic() + timeout
         first_done_ok: Optional[float] = None
         while time.monotonic() < deadline:
+            self._revive_ps(max_restarts, restart_backoff)
             rcs = self.poll()
             failed = [i for i, rc in enumerate(rcs) if rc not in (None, 0)]
             if any(self.restarts[i] >= max_restarts for i in failed):
@@ -262,6 +324,43 @@ class Job:
         self.kill()
         return [p.returncode for p in self._procs]
 
+    def _revive_ps(self, max_restarts: int,
+                   restart_backoff: float = 0.0) -> None:
+        """Restart a dead parameter-server process (primary or standby)
+        mid-supervision — the cold-restart half of the failover story: a
+        primary relaunched on its ``state_dir`` resumes center/counter/
+        dedup state and the workers' retransmits dedup exactly-once. A
+        primary revived AFTER a standby promoted simply comes back fenced
+        (the promotion's epoch outranks it). Mirrors the worker-restart
+        policy: ``max_restarts`` budget *per role* (a flapping standby
+        must not drain the primary's budget; default 0 = off) and a
+        full-jitter delay per restart (a PS crashing on startup must not
+        burn its whole budget in one polling second)."""
+        from distkeras_tpu import telemetry
+
+        for attr, role, cmd_fn, host in (
+                ("_ps_proc", "primary", self.render_ps_command,
+                 (self.punchcard.ps or {}).get("host")
+                 or self.punchcard.hosts[0]),
+                ("_standby_proc", "standby", self.render_standby_command,
+                 (self.punchcard.ps or {}).get("standby_host"))):
+            p = getattr(self, attr)
+            # rc 0 is a deliberate drain (operator SIGTERM), not a crash —
+            # same exemption the worker-restart policy applies.
+            if p is None or p.poll() is None or p.returncode == 0:
+                continue
+            n = self._ps_role_restarts.get(role, 0)
+            if n >= max_restarts:
+                continue
+            time.sleep(full_jitter(restart_backoff, n))
+            self._ps_role_restarts[role] = n + 1
+            self.ps_restarts += 1
+            telemetry.counter("resilience.ps_restarts").add(1)
+            telemetry.event("ps_restart", {
+                "role": role, "exit_code": p.returncode,
+                "restart": self.ps_restarts})
+            setattr(self, attr, self._spawn_cmd(host, cmd_fn()))
+
     def kill(self, grace: float = 5.0) -> None:
         """Tear down every launched process that is still running:
         SIGTERM first, then — for anything still alive after ``grace``
@@ -271,8 +370,9 @@ class Job:
         unreapable (D-state) process is abandoned rather than hanging the
         caller."""
         live = [p for p in self._procs if p.poll() is None]
-        if self._ps_proc is not None and self._ps_proc.poll() is None:
-            live.append(self._ps_proc)
+        for ps in (self._ps_proc, self._standby_proc):
+            if ps is not None and ps.poll() is None:
+                live.append(ps)
         for p in live:
             try:
                 p.terminate()

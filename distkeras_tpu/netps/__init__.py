@@ -35,7 +35,19 @@ production edges the reference never had:
 * :mod:`~distkeras_tpu.netps.hier` — hierarchical two-level folds
   (``DKTPU_NET_HIER=1``): :class:`AggregatorServer` pre-combines a host's
   commits and forwards one combined commit upstream, cutting root ingress
-  by the worker fan-in.
+  by the worker fan-in;
+* :mod:`~distkeras_tpu.netps.state` — durable center state
+  (``--state-dir`` / ``DKTPU_PS_STATE_DIR``): a write-ahead journal of
+  folded commits plus periodic snapshots with sha256 sidecars; a killed
+  server cold-restarts with the center, counter, and dedup table intact
+  and in-flight commits retransmit exactly-once;
+* :mod:`~distkeras_tpu.netps.standby` — warm-standby failover
+  (``--standby`` / ``DKTPU_PS_STANDBY``): :class:`StandbyServer` tails
+  the primary's journal stream over the wire, promotes itself when the
+  primary's lease lapses, and fences the old epoch — stale-lineage
+  commits answer a typed ``EpochFencedError``, never a fold; clients
+  walk a comma-separated ``DKTPU_PS_ENDPOINT`` list to the promoted
+  primary and reconcile seq state on re-join.
 
 The data plane (compute/comms overlap, compressed deltas, sharded
 striping over ``DKTPU_NET_SHARDS`` connections, zero-copy frames) is
@@ -51,8 +63,10 @@ from __future__ import annotations
 from distkeras_tpu.netps.chaos import ChaosProxy  # noqa: F401
 from distkeras_tpu.netps.client import CommitResult, PSClient  # noqa: F401
 from distkeras_tpu.netps.errors import (  # noqa: F401
+    EpochFencedError,
     LeaseExpiredError,
     NetPSError,
+    NotPrimaryError,
     ProtocolError,
     RPCTimeoutError,
     ServerClosedError,
@@ -65,11 +79,13 @@ from distkeras_tpu.netps.fold import (  # noqa: F401
 )
 from distkeras_tpu.netps.hier import AggregatorServer  # noqa: F401
 from distkeras_tpu.netps.server import PSServer, serve  # noqa: F401
+from distkeras_tpu.netps.standby import StandbyServer  # noqa: F401
 
 __all__ = [
     "PSServer", "serve", "PSClient", "CommitResult", "ChaosProxy",
-    "AggregatorServer",
+    "AggregatorServer", "StandbyServer",
     "NetPSError", "ProtocolError", "RPCTimeoutError", "ServerDrainingError",
-    "LeaseExpiredError", "ServerClosedError",
+    "LeaseExpiredError", "ServerClosedError", "EpochFencedError",
+    "NotPrimaryError",
     "SUPPORTED_DISCIPLINES", "commit_scale", "fold_delta",
 ]

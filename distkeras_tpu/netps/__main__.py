@@ -1,26 +1,46 @@
-"""CLI: run a standalone netps parameter server.
+"""CLI: run a standalone netps parameter server (primary or warm standby).
 
 ``Job``/``Punchcard`` launch this on the PS host of a pod::
 
     python -m distkeras_tpu.netps --host 0.0.0.0 --port 7077 \
-        --discipline adag --lease 10
+        --discipline adag --lease 10 --state-dir /var/dktpu/ps
 
 The server starts uninitialized — the first worker's ``join`` seeds the
 center with its model parameters, so this process needs no model (or jax)
-knowledge. It prints ``NETPS_READY <host:port>`` once listening and runs
-until SIGTERM/SIGINT, then drains gracefully (in-flight commits finish,
-late clients get a typed ``ServerDrainingError``).
+knowledge. With ``--state-dir`` (``DKTPU_PS_STATE_DIR``) every folded
+commit is journaled and the center snapshotted (``--snapshot-every`` /
+``DKTPU_PS_SNAPSHOT_EVERY``), so a SIGKILLed server relaunched on the same
+directory resumes its center, counter, and dedup state. With ``--standby
+host:port`` (``DKTPU_PS_STANDBY``) the process runs as a warm standby of
+that primary instead: it tails the journal stream, serves nothing until
+the primary's lease lapses, then promotes (printing ``NETPS_PROMOTED
+epoch=N``) and fences the old lineage.
+
+It prints ``NETPS_READY <host:port>`` once listening and runs until
+SIGTERM/SIGINT, then drains gracefully (in-flight commits finish, late
+clients get a typed ``ServerDrainingError``). The FIRST signal prints
+``NETPS_DRAINING`` immediately — at signal time, not after the drain — so
+a supervisor (``Job.supervise``) can tell a draining PS from a hung one; a
+SECOND signal during the drain force-exits nonzero (status 70) instead of
+being silently swallowed.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 import threading
 
 from distkeras_tpu.netps.fold import SUPPORTED_DISCIPLINES
 from distkeras_tpu.netps.server import PSServer
+from distkeras_tpu.runtime import config
+
+#: exit status of a second-signal forced abort (EX_SOFTWARE; distinct from
+#: both a clean drain's 0 and a SIGKILL's -9 so ``Job.supervise`` can tell
+#: the three apart).
+ABORT_STATUS = 70
 
 
 def main(argv=None) -> int:
@@ -33,20 +53,65 @@ def main(argv=None) -> int:
                     choices=sorted(SUPPORTED_DISCIPLINES))
     ap.add_argument("--lease", type=float, default=None,
                     help="membership lease seconds (default DKTPU_PS_LEASE)")
+    ap.add_argument("--state-dir", default=None,
+                    help="durable journal+snapshot directory (default "
+                         "DKTPU_PS_STATE_DIR; empty = in-memory only)")
+    ap.add_argument("--snapshot-every", type=int, default=None,
+                    help="folds between center snapshots (default "
+                         "DKTPU_PS_SNAPSHOT_EVERY)")
+    ap.add_argument("--standby", metavar="HOST:PORT", default=None,
+                    help="run as a warm standby of this primary (default "
+                         "DKTPU_PS_STANDBY; empty = run as a primary)")
+    ap.add_argument("--promote-after", type=float, default=None,
+                    help="seconds of primary silence before a standby "
+                         "promotes itself (default: the lease)")
     args = ap.parse_args(argv)
-    server = PSServer(discipline=args.discipline, host=args.host,
-                      port=args.port, lease_s=args.lease).start()
+    state_dir = (args.state_dir if args.state_dir is not None
+                 else config.env_str("DKTPU_PS_STATE_DIR") or None)
+    standby_of = (args.standby if args.standby is not None
+                  else config.env_str("DKTPU_PS_STANDBY") or None)
+    kw = dict(discipline=args.discipline, host=args.host, port=args.port,
+              lease_s=args.lease, state_dir=state_dir,
+              snapshot_every=args.snapshot_every)
+    if standby_of:
+        from distkeras_tpu.netps.standby import StandbyServer
+
+        server = StandbyServer(standby_of,
+                               promote_after=args.promote_after,
+                               **kw).start()
+    else:
+        server = PSServer(**kw).start()
     stop = threading.Event()
+    signals_seen = [0]
 
     def _stop(signum, frame):
-        stop.set()
+        signals_seen[0] += 1
+        if signals_seen[0] == 1:
+            # Printed AT SIGNAL TIME (os.write: async-signal-safe, no
+            # buffering), before the drain starts — a supervisor watching
+            # stdout can distinguish "draining, give it a moment" from
+            # "hung, escalate" without guessing.
+            os.write(1, b"NETPS_DRAINING\n")
+            stop.set()
+        else:
+            # A second signal mid-drain means the operator (or Job.kill's
+            # escalation) wants OUT — force-exit nonzero rather than
+            # letting _stop silently swallow it while close() blocks on a
+            # wedged handler thread.
+            os.write(1, b"NETPS_ABORTED\n")
+            os._exit(ABORT_STATUS)
 
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
     print(f"NETPS_READY {server.endpoint}", flush=True)
-    stop.wait()
+    announced = False
+    while not stop.wait(0.2):
+        if (not announced and getattr(server, "promoted", False)):
+            announced = True
+            print(f"NETPS_PROMOTED epoch={server.epoch}", flush=True)
     server.close()
-    print(f"NETPS_DRAINED commits={len(server.commit_log)} "
+    print(f"NETPS_DRAINED commits={server.commits_total} "
+          f"epoch={server.epoch} snapshots={server.snapshots_written} "
           f"evictions={server.evictions} rejoins={server.rejoins}",
           flush=True)
     return 0

@@ -63,8 +63,10 @@ import numpy as np
 
 from distkeras_tpu.netps import shm, wire
 from distkeras_tpu.netps.errors import (
+    EpochFencedError,
     LeaseExpiredError,
     NetPSError,
+    NotPrimaryError,
     ProtocolError,
     RPCTimeoutError,
     ServerClosedError,
@@ -73,13 +75,21 @@ from distkeras_tpu.netps.errors import (
 from distkeras_tpu.resilience.backoff import full_jitter
 from distkeras_tpu.runtime import config
 
-#: server error kind -> typed exception. Everything here is NON-retryable:
-#: the server answered, it just said no.
+#: server error kind -> typed exception. Everything here except
+#: ``not_primary`` is NON-retryable: the server answered, it just said no.
+#: ``not_primary`` (an unpromoted standby / a fenced ex-primary) is
+#: retryable *by walking the endpoint list* — the same RPC against the
+#: next endpoint can succeed, so ``_rpc`` treats it like a transport
+#: failure. ``epoch_fenced`` surfaces typed: the caller re-joins (walking
+#: to the promoted primary) and discards its stale window, exactly like an
+#: eviction.
 _ERROR_TYPES = {
     "draining": ServerDrainingError,
     "lease_expired": LeaseExpiredError,
     "uninitialized": NetPSError,
     "protocol": ProtocolError,
+    "epoch_fenced": EpochFencedError,
+    "not_primary": NotPrimaryError,
 }
 
 #: striped-pull consistency budget: whole-pull re-reads before falling back
@@ -134,7 +144,12 @@ class PSClient:
                  shards: Optional[int] = None,
                  compress: Optional[str] = None,
                  transport: Optional[str] = None):
-        self._host, self._port = wire.split_endpoint(endpoint)
+        #: ordered (host, port) failover list — ``endpoint`` may be the
+        #: comma-separated ``DKTPU_PS_ENDPOINT`` form (primary first, then
+        #: standbys); a single endpoint is a one-element list and behaves
+        #: exactly as before.
+        self._endpoints = wire.split_endpoints(endpoint)
+        self._ep_idx = 0
         self.endpoint = endpoint
         self.worker_id = worker_id
         self.timeout = float(timeout if timeout is not None
@@ -173,6 +188,12 @@ class PSClient:
         #: freshly re-established TCP socket mid-RPC.
         self._fallback_lock = threading.Lock()
         self.lease_s: Optional[float] = None
+        #: the primary epoch the last join adopted (None until a join
+        #: against an epoch-aware server); rides in every pull/commit/
+        #: heartbeat header so a promoted standby can fence the stale
+        #: lineage and a zombie ex-primary can fence ITSELF on sight of a
+        #: higher epoch.
+        self.epoch: Optional[int] = None
         self._conns = [_Conn() for _ in range(self.shards)]
         self._pool: Optional[ThreadPoolExecutor] = None
         #: tensor-index stripes per shard, from the joined center's shapes.
@@ -213,7 +234,7 @@ class PSClient:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             raise socket.timeout("deadline exceeded before connect")
-        sock = socket.create_connection((self._host, self._port),
+        sock = socket.create_connection(self._current_endpoint(),
                                         timeout=remaining)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         conn.sock = sock
@@ -225,6 +246,29 @@ class PSClient:
     def active_transport(self) -> str:
         """The dialect the data connections speak right now."""
         return "shm" if self.shm_info is not None else "tcp"
+
+    def _current_endpoint(self) -> tuple[str, int]:
+        return self._endpoints[self._ep_idx % len(self._endpoints)]
+
+    def _walk_endpoints(self, seen_idx: int) -> None:
+        """Advance to the next endpoint after a failure observed against
+        ``seen_idx`` (CAS'd under the fallback lock so N stripe threads
+        failing together advance ONE step, not N). Walking drops every
+        connection and any ring attachment — the next endpoint is a
+        different process; nothing negotiated with the old one survives."""
+        if len(self._endpoints) <= 1:
+            return
+        from distkeras_tpu import telemetry
+
+        with self._fallback_lock:
+            walked = self._ep_idx == seen_idx
+            if walked:
+                self._ep_idx = (seen_idx + 1) % len(self._endpoints)
+                self.shm_info = None
+                for conn in self._conns:
+                    self._disconnect(conn)
+        if walked:
+            telemetry.counter("netps.endpoint_walks").add(1)
 
     @staticmethod
     def _disconnect(conn: _Conn) -> None:
@@ -287,8 +331,25 @@ class PSClient:
 
         conn = self._conns[conn_idx]
         attempts = self.retries + 1
+        # Failover patience: with standbys configured, the retry budget
+        # must bridge the PROMOTION window, not just a flaky frame — the
+        # standby only takes over after the primary's lease lapses, and
+        # with default knobs the attempt budget alone (~1.5 s) would give
+        # up ~one lease before anyone is primary again. So multi-endpoint
+        # clients keep walking until at least 2x the lease (detection +
+        # promotion) + one deadline has elapsed, however many attempts
+        # that takes. Single-endpoint clients keep the strict budget —
+        # nothing is coming to save them, failing fast is correct.
+        patience = None
+        if len(self._endpoints) > 1:
+            lease = self.lease_s
+            if not lease:
+                lease = config.env_float("DKTPU_PS_LEASE")
+            patience = (time.monotonic() + 2.0 * float(lease or 0.0)
+                        + self.timeout)
         last_exc: Optional[BaseException] = None
-        for attempt in range(attempts):
+        attempt = 0
+        while True:
             conn.req += 1
             req = conn.req
             hdr = dict(header, op=op, req=req)
@@ -303,9 +364,24 @@ class PSClient:
             dialect = ".shm" if self.shm_info is not None else ""
             label = (f"netps.rpc.{op}.s{header['shard']}{dialect}"
                      if "shard" in header else f"netps.rpc.{op}{dialect}")
+            ep_seen = self._ep_idx
             try:
                 with telemetry.span(label):
                     return self._attempt(conn, req, hdr, arrays)
+            except NotPrimaryError as e:
+                # The peer answered, but it is a standby (not yet
+                # promoted) or a fenced ex-primary: retry by WALKING the
+                # endpoint list — the same RPC against the next endpoint
+                # (or this one, after promotion) can succeed.
+                last_exc = e
+                self._disconnect(conn)
+                self._walk_endpoints(ep_seen)
+                if not self._budget_left(attempt, attempts, patience):
+                    break
+                telemetry.counter("netps.retries").add(1)
+                time.sleep(full_jitter(self.backoff, min(attempt, 6)))
+                attempt += 1
+                continue
             except (socket.timeout, ConnectionError, OSError,
                     ProtocolError) as e:
                 if getattr(e, "from_reply", False):
@@ -334,14 +410,40 @@ class PSClient:
                                 self._disconnect(other)
                     if swept:
                         telemetry.counter("netps.shm_fallbacks").add(1)
-                if attempt + 1 < attempts:
-                    telemetry.counter("netps.retries").add(1)
-                    time.sleep(full_jitter(self.backoff, attempt))
+                # A transport failure with standbys configured also walks
+                # — a dead primary never answers again, and the retransmit
+                # (same seq) is exactly-once-safe wherever it lands — but
+                # only once a retry against the SAME endpoint has also
+                # failed (the shm-fallback rule): walking tears down every
+                # stripe's connection, so a single flaky frame against a
+                # healthy primary must not pay a full teardown plus a
+                # wasted hop to the unpromoted standby.
+                if attempt >= 1 or attempt + 1 == attempts:
+                    self._walk_endpoints(ep_seen)
+                if not self._budget_left(attempt, attempts, patience):
+                    break
+                telemetry.counter("netps.retries").add(1)
+                time.sleep(full_jitter(self.backoff, min(attempt, 6)))
+                attempt += 1
         telemetry.counter("netps.rpc_failures").add(1)
+        if isinstance(last_exc, NotPrimaryError):
+            # Every endpoint we could reach is a standby (or a fenced
+            # ex-primary): surface that typed — "nobody is primary yet" is
+            # actionable in a way a generic timeout is not.
+            raise last_exc
         raise RPCTimeoutError(
-            f"{op} to {self.endpoint} failed after {attempts} attempts "
+            f"{op} to {self.endpoint} failed after {attempt + 1} attempts "
             f"(last: {type(last_exc).__name__}: {last_exc})",
-            attempts=attempts)
+            attempts=attempt + 1)
+
+    @staticmethod
+    def _budget_left(attempt: int, attempts: int,
+                     patience: Optional[float]) -> bool:
+        """May the retry loop go around again? The attempt budget, OR —
+        multi-endpoint only — the failover patience window."""
+        if attempt + 1 < attempts:
+            return True
+        return patience is not None and time.monotonic() < patience
 
     def _attempt(self, conn: _Conn, req: int, hdr: dict,
                  arrays: Sequence) -> tuple[dict, list]:
@@ -405,6 +507,13 @@ class PSClient:
                 raise exc
             return rhdr, rarrays
 
+    def _stamped(self, header: dict) -> dict:
+        """Stamp the adopted epoch into a member-op header (no-op against
+        pre-epoch servers — we never claim an epoch we were not given)."""
+        if self.epoch is not None:
+            header["epoch"] = self.epoch
+        return header
+
     # -- striping helpers ---------------------------------------------------
     def _compute_stripes(self, template: Sequence[np.ndarray]) -> None:
         """Byte-balanced greedy stripe assignment of tensor indices over the
@@ -461,6 +570,11 @@ class PSClient:
                                 list(init or ()))
         self.worker_id = int(hdr["worker_id"])
         self.lease_s = hdr.get("lease_s")
+        # A join ADOPTS the server's epoch (a failover re-join is exactly
+        # this client arriving with a stale lineage); pre-epoch servers
+        # never send one and this client then never claims one.
+        self.epoch = (int(hdr["epoch"]) if hdr.get("epoch") is not None
+                      else None)
         caps = hdr.get("caps") or {}
         self.codec = (self.requested_codec
                       if self.requested_codec in caps.get("codecs", ())
@@ -504,6 +618,7 @@ class PSClient:
         this so both lanes speak the same wire."""
         self.codec = other.codec
         self.active_shards = other.active_shards
+        self.epoch = other.epoch
         with self._fallback_lock:  # vs a concurrent fallback sweep
             self.shm_info = other.shm_info
         self._compute_stripes(template)
@@ -517,8 +632,10 @@ class PSClient:
         try:
             if self._striped():
                 return self._striped_pull()
-            hdr, center = self._rpc("pull", {})
-        except LeaseExpiredError:
+            hdr, center = self._rpc("pull", self._stamped({}))
+        except (LeaseExpiredError, EpochFencedError):
+            # Fenced reads exactly like evicted: the old lineage is gone;
+            # re-join (walking to the promoted primary) and adopt.
             if not self.auto_rejoin:
                 raise
             self.rejoin_count += 1
@@ -532,8 +649,9 @@ class PSClient:
         for _ in range(_PULL_CONSISTENT_TRIES):
             futures = [
                 pool.submit(self._rpc, "pull",
-                            {"shard": s, "num_shards": len(stripes),
-                             "idx": idx}, (), s)
+                            self._stamped({"shard": s,
+                                           "num_shards": len(stripes),
+                                           "idx": idx}), (), s)
                 for s, idx in enumerate(stripes)]
             replies = self._gather(futures)
             counters = {int(h["updates"]) for h, _ in replies}
@@ -548,7 +666,7 @@ class PSClient:
 
             telemetry.counter("netps.pull_torn_retries").add(1)
         # Persistent contention: one unsharded pull is always consistent.
-        hdr, center = self._rpc("pull", {})
+        hdr, center = self._rpc("pull", self._stamped({}))
         return center, int(hdr["updates"])
 
     def _compress_delta(self, delta: Sequence[np.ndarray]) -> list:
@@ -583,14 +701,17 @@ class PSClient:
         self._seq += 1
         seq = self._seq
         items = self._compress_delta(delta)
-        base = {"seq": seq, "pulled": int(pulled_counter)}
+        base = self._stamped({"seq": seq, "pulled": int(pulled_counter)})
         try:
             if self._striped() and len(items) == sum(
                     len(s) for s in self._stripes):
                 hdr = self._striped_commit(base, items)
             else:
                 hdr, _ = self._rpc("commit", base, items)
-        except LeaseExpiredError:
+        except (LeaseExpiredError, EpochFencedError):
+            # Fenced commit = evicted commit: it was NEVER folded (the
+            # whole point of the fence); discard the window, re-join the
+            # promoted primary, continue from a fresh pull.
             if not self.auto_rejoin:
                 raise
             self.rejoin_count += 1
@@ -645,8 +766,8 @@ class PSClient:
     def heartbeat(self) -> int:
         """Renew the lease; returns the server's update counter."""
         try:
-            hdr, _ = self._rpc("heartbeat", {})
-        except LeaseExpiredError:
+            hdr, _ = self._rpc("heartbeat", self._stamped({}))
+        except (LeaseExpiredError, EpochFencedError):
             if not self.auto_rejoin:
                 raise
             self.rejoin_count += 1

@@ -45,6 +45,24 @@ class LeaseExpiredError(NetPSError):
     discards the in-flight window and continues from a fresh pull."""
 
 
+class EpochFencedError(NetPSError):
+    """The commit carried a primary epoch the server no longer honors: a
+    standby promoted and fenced the old lineage (stale client epoch), or
+    this server itself was fenced by a higher epoch (it is the zombie).
+    The hardened client reacts like an eviction — re-join (walking the
+    endpoint list to the promoted primary), adopt the new epoch, discard
+    the stale window. Never folded: the whole point is zero stale-epoch
+    folds after a failover."""
+
+
+class NotPrimaryError(NetPSError):
+    """The peer answered but is not the primary: a warm standby that has
+    not (yet) promoted, or a fenced ex-primary. Retryable *by walking the
+    endpoint list* — the same RPC against the next endpoint (or this one
+    after promotion) can succeed, so the client treats it like a transport
+    failure rather than a terminal rejection."""
+
+
 class ServerClosedError(NetPSError):
     """A parameter-server object (networked or the in-process raced twin)
     was used after ``close()``. Worker threads blocked on it must exit,

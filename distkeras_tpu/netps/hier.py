@@ -168,6 +168,11 @@ class AggregatorServer(PSServer):
         self.absorbed += 1
         self.commit_log.append((wid, seq, staleness))
         self._last_seq[wid] = seq
+        self.commits_total += 1
+        # The same month-long-run bound the root server keeps: the
+        # aggregator's absorbed-commit evidence must not grow without
+        # limit either (len + dropped == commits_total holds here too).
+        self._trim_log_locked(2 * self._log_keep)
         self._purge_pending(wid, below_seq=seq)
         self._flush_cv.notify_all()
         return staleness

@@ -39,20 +39,25 @@ segments. Same handlers, same dispatch, same guarantees.
 
 from __future__ import annotations
 
+import collections
 import os
+import signal
 import socket
 import tempfile
 import threading
 import time
+import uuid
 from typing import Optional, Sequence
 
 import numpy as np
 
 from distkeras_tpu.netps import shm, wire
+from distkeras_tpu.netps import state as _state
 from distkeras_tpu.netps.errors import ProtocolError
 from distkeras_tpu.netps.fold import (check_discipline, decode_entry,
                                       fold_delta, resolve_backend,
                                       validate_delta)
+from distkeras_tpu.resilience import faults as _faults
 from distkeras_tpu.runtime import config
 
 #: handler/accept poll tick: how often blocked threads wake to check stop.
@@ -60,6 +65,17 @@ _POLL_S = 0.2
 #: once a frame's first bytes arrive, the rest must land within this —
 #: a peer that stalls mid-frame is dead, not idle.
 _FRAME_COMPLETE_S = 30.0
+#: in-memory commit-log bound: the evidence list is compacted (oldest
+#: half dropped, counted in ``commits_total``) once it doubles this, and
+#: trimmed to it at snapshot time — a month-long run must not grow an
+#: unbounded Python list next to the center.
+_COMMIT_LOG_KEEP = 65536
+#: replication tail depth: folded commits kept (in wire form) for a
+#: standby's ``replicate`` pulls; a standby further behind than this gets
+#: a full snapshot sync instead.
+_REPL_BUFFER = 64
+#: max journal records per ``replicate`` reply (bounds the frame size).
+_REPL_BATCH = 16
 
 
 class PSServer:
@@ -74,7 +90,12 @@ class PSServer:
     def __init__(self, center: Optional[Sequence[np.ndarray]] = None,
                  discipline: str = "adag", host: str = "127.0.0.1",
                  port: int = 0, lease_s: Optional[float] = None,
-                 transport: Optional[str] = None):
+                 transport: Optional[str] = None,
+                 state_dir: Optional[str] = None,
+                 snapshot_every: Optional[int] = None,
+                 epoch: int = 0,
+                 commit_log_keep: Optional[int] = None,
+                 standby: bool = False):
         self.discipline = check_discipline(discipline)
         self.transport = (transport if transport is not None
                           else shm.transport_mode())
@@ -94,6 +115,37 @@ class PSServer:
         self._last_seq: dict = {}
         #: every worker_id ever admitted (rejoin accounting + id assignment).
         self._ever: set = set()
+        #: primary epoch: joins/commits carry it; a commit from a lineage
+        #: this server no longer honors (or that no longer honors this
+        #: server) is fenced, never folded. Bumped only by a standby's
+        #: promotion (``netps/standby.py``).
+        self.epoch = int(epoch)
+        #: a higher epoch exists somewhere: this server is the zombie and
+        #: must never fold again (join/pull/commit all answer ``standby``).
+        self._fenced = False
+        #: a warm standby serves nothing until it promotes.
+        self._not_primary = bool(standby)
+        #: all commits ever folded — ``commit_log`` is the bounded tail of
+        #: it (``len(commit_log) + dropped == commits_total`` always).
+        self.commits_total = 0
+        self.snapshots_written = 0
+        self._log_dropped = 0
+        self._log_keep = int(commit_log_keep if commit_log_keep is not None
+                             else _COMMIT_LOG_KEEP)
+        #: per-incarnation lineage token, echoed on every ``replicate``
+        #: reply: a restarted primary may have LOST the tail of its fold
+        #: history (the bounded writer queue died with it), so fold
+        #: indices alone cannot prove a standby's center still matches —
+        #: same index, different history. A standby that sees the token
+        #: change discards its state and full-syncs (the primary's
+        #: durable state is the authoritative lineage).
+        self.lineage = uuid.uuid4().hex
+        #: replication tail (pre-fold index, wid, seq, staleness, wire
+        #: delta); only populated once a standby's first ``replicate``
+        #: arrives — no memory tax on un-replicated deployments.
+        self._repl: collections.deque = collections.deque(
+            maxlen=_REPL_BUFFER)
+        self._repl_on = False
         #: striped commits awaiting assembly: (worker_id, seq) ->
         #: {shard: (idx tuple, arrays)}. One logical commit spans
         #: ``num_shards`` stripe sub-requests under ONE seq; the stripe
@@ -107,6 +159,34 @@ class PSServer:
         #: (tensors, seconds) of the most recent fold — written under the
         #: lock, exported as the fold-throughput gauge after release.
         self._fold_stats = (0, 0.0)
+        #: durable state (``--state-dir``): journal + snapshots + recovery.
+        #: Must come after the commit_log init — a ctor-seeded center with
+        #: a fresh dir snapshots right here.
+        self._store: Optional[_state.StateStore] = None
+        if state_dir:
+            self._store = _state.StateStore(state_dir, snapshot_every)
+            rec = self._store.recover(self.discipline)
+            if rec is not None:
+                # The disk is authoritative over any ctor-passed center: a
+                # restart resumes the folded lineage, it does not reseed.
+                self._center = rec.center
+                self._updates = rec.updates
+                self._last_seq = dict(rec.last_seq)
+                self._ever = set(rec.last_seq)
+                self.epoch = max(self.epoch, rec.epoch)
+                self.commits_total = rec.commits_total
+                # A fence that landed on the previous incarnation is
+                # durable: the zombie stays a zombie across restarts.
+                self._fenced = self._fenced or rec.fenced
+                # The pre-crash commits are not in this incarnation's log:
+                # they count as "dropped" so the bound invariant
+                # len(commit_log) + dropped == commits_total keeps holding.
+                self._log_dropped = rec.commits_total
+            self._store.open_journal(self._updates)
+            if self._center is not None and rec is None:
+                # Ctor-seeded center with a fresh dir: anchor the journal
+                # with the base snapshot a recovery will replay onto.
+                self._snapshot_locked()
         self.evictions = 0
         self.rejoins = 0
         self._draining = False
@@ -191,6 +271,8 @@ class PSServer:
         release the listener. Idempotent."""
         self.drain()
         self._stop.set()
+        if self._store is not None:
+            self._store.close()
         if self._accept_thread is not None:
             self._accept_thread.join()
         if self._uds_accept_thread is not None:
@@ -374,10 +456,42 @@ class PSServer:
             return None
         telemetry.counter("netps.bytes_received").add(nbytes)
         op = header.get("op", "")
+        if op == "commit":
+            self._chaos_hooks()
         with telemetry.span(f"netps.server.{op or 'unknown'}{dialect}"):
             reply, out = self._dispatch(op, header, arrays)
+        err = reply.get("error")
+        if op == "commit" and err == "epoch_fenced":
+            # The zero-stale-epoch-folds evidence: every fenced commit is
+            # a commit that did NOT reach the fold.
+            telemetry.counter("netps.failover.fenced_commits").add(1)
+        elif op == "replicate" and reply.get("mode") == "snapshot":
+            telemetry.counter("netps.failover.snapshot_syncs").add(1)
+        elif op == "fence" and reply.get("fenced"):
+            telemetry.counter("netps.failover.fences_accepted").add(1)
+            telemetry.event("netps_fenced", {"epoch": reply.get("epoch")})
+        if self._store is not None and op in ("commit", "join"):
+            telemetry.gauge("netps.recovery.snapshots").set(
+                float(self.snapshots_written))
         reply["req"] = header.get("req")
         return reply, out
+
+    def _chaos_hooks(self) -> None:
+        """The PS-side chaos kinds, consulted per commit *request* (no
+        proxy can kill this process for us). ``ps_hang@R:S`` sleeps S
+        seconds HOLDING the center lock — every member's lease renewal
+        queues behind a genuinely wedged server; ``ps_crash@R`` is the
+        kill-the-primary drill: SIGKILL, mid-run, no goodbye."""
+        plan = _faults.active_net_plan()
+        if plan is None:
+            return
+        at = self.commits_total
+        arg = plan.fire("ps_hang", at)
+        if arg:
+            with self._lock:
+                time.sleep(arg)
+        if plan.fire("ps_crash", at) is not None:
+            os.kill(os.getpid(), signal.SIGKILL)
 
     def _dispatch(self, op: str, header: dict,
                   arrays: list) -> tuple[dict, list]:
@@ -391,6 +505,10 @@ class PSServer:
             return self._op_heartbeat(header)
         if op == "leave":
             return self._op_leave(header)
+        if op == "replicate":
+            return self._op_replicate(header)
+        if op == "fence":
+            return self._op_fence(header)
         return {"error": "protocol", "message": f"unknown op {op!r}"}, []
 
     @staticmethod
@@ -417,6 +535,12 @@ class PSServer:
         # decoding here is a per-tensor passthrough.
         init = [decode_entry(a) for a in arrays]
         with self._lock:
+            # A join never carries an epoch — it ADOPTS the server's (the
+            # failover re-join is exactly a stale-lineage client arriving
+            # here) — so only the fenced/standby half of the check applies.
+            err = self._check_primary_locked({})
+            if err is not None:
+                return err
             if self._draining:
                 return self._err("draining", "server is draining")
             if wid is None:
@@ -425,6 +549,10 @@ class PSServer:
             rejoin = wid in self._ever and wid not in self._members
             if self._center is None and init:
                 self._center = [np.array(a, np.float32) for a in init]
+                if self._store is not None:
+                    # First center this store has seen: anchor the journal
+                    # with the base snapshot recovery will replay onto.
+                    self._snapshot_locked()
             if self._center is None:
                 return self._err(
                     "uninitialized",
@@ -454,12 +582,15 @@ class PSServer:
             caps["shm"] = {"boot_id": self._boot_id, "uds": self._uds_path}
         return ({"ok": True, "worker_id": wid, "updates": updates,
                  "lease_s": self.lease_s, "last_seq": last_seq,
-                 "caps": caps}, center)
+                 "epoch": self.epoch, "caps": caps}, center)
 
     def _op_pull(self, header: dict) -> tuple[dict, list]:
         wid = header.get("worker_id")
         idx = header.get("idx")
         with self._lock:
+            err = self._check_primary_locked(header)
+            if err is not None:
+                return err
             if self._center is None:
                 return self._err("uninitialized", "no center yet")
             if wid is not None:
@@ -507,6 +638,9 @@ class PSServer:
             telemetry.counter("netps.protocol_errors").add(1)
             return self._err("protocol", str(e))
         with self._lock:
+            err = self._check_primary_locked(header)
+            if err is not None:
+                return err
             if self._draining:
                 return self._err("draining", "server is draining")
             if wid not in self._members:
@@ -549,16 +683,89 @@ class PSServer:
 
     def _fold_locked(self, wid: int, seq: int, pulled, delta: list) -> int:
         """The ONE fold (lock held): staleness from the counter rule, then
-        ``fold_delta`` and the exactly-once bookkeeping."""
+        ``fold_delta``, the exactly-once bookkeeping, and the durability
+        tail — journal append (fold order IS journal order, which is why
+        this stays under the lock), snapshot-when-due, the replication
+        buffer, and the commit-log bound."""
         staleness = self._updates - int(pulled)
         t0 = time.perf_counter()
         fold_delta(self._center, delta, self.discipline, staleness)
         self._fold_stats = (len(delta), time.perf_counter() - t0)
+        u = self._updates
         self.commit_log.append((wid, seq, staleness))
         self._last_seq[wid] = seq
         self._updates += 1
+        self.commits_total += 1
         self._purge_pending(wid, below_seq=seq)
+        if self._repl_on:
+            # Wire-form tail for the standby's `replicate` pulls. Entries
+            # keep their frame buffers alive (bounded by the deque).
+            self._repl.append({"u": u, "wid": wid, "seq": seq,
+                               "st": staleness, "e": self.epoch,
+                               "n": self.commits_total,
+                               "delta": list(delta)})
+        if self._store is not None:
+            self._store.append(epoch=self.epoch, wid=wid, seq=seq,
+                               staleness=staleness, updates=u,
+                               commits_total=self.commits_total,
+                               delta=delta)
+            if self._store.due(self._updates):
+                self._snapshot_locked()
+        # Hard bound between snapshots (or without a store at all): a
+        # month-long run must not grow an unbounded evidence list.
+        self._trim_log_locked(2 * self._log_keep)
         return staleness
+
+    def _trim_log_locked(self, threshold: int) -> None:
+        """Drop the oldest commit-log entries back to the keep bound once
+        the list reaches ``threshold`` (lock held) — the ONE place the
+        ``len(commit_log) + dropped == commits_total`` invariant is
+        maintained (fold path, snapshot compaction, the aggregator's
+        absorb path, and the standby's replication all call in here)."""
+        if len(self.commit_log) >= threshold > self._log_keep:
+            drop = len(self.commit_log) - self._log_keep
+            del self.commit_log[:drop]
+            self._log_dropped += drop
+
+    def _snapshot_locked(self) -> None:
+        """Write one center snapshot + rotate/compact the journal (lock
+        held; the store is deliberately telemetry-free under it — the
+        dispatch layer exports ``netps.recovery.snapshots`` after release)
+        and trim the in-memory commit log to its keep bound."""
+        self._store.snapshot(center=self._center, updates=self._updates,
+                             last_seq=self._last_seq, epoch=self.epoch,
+                             commits_total=self.commits_total)
+        self.snapshots_written += 1
+        self._trim_log_locked(self._log_keep + 1)
+
+    def _check_primary_locked(self, header: dict):
+        """The epoch fence (lock held): None when this server may serve
+        the request, else the typed error reply. A fenced or
+        not-yet-promoted server answers ``not_primary`` (the client walks
+        its endpoint list); a request from a STALE epoch answers
+        ``epoch_fenced`` (the client re-joins and adopts the new lineage);
+        a request from a HIGHER epoch is proof somebody promoted past this
+        server — it fences itself on the spot, so a zombie primary can
+        never fold again even if the promotion's ``fence`` op was lost."""
+        if self._not_primary:
+            return self._err("not_primary", "warm standby, not promoted")
+        epoch = header.get("epoch")
+        if epoch is not None and int(epoch) > self.epoch and not self._fenced:
+            # Caller holds the center lock (every _op_* takes it before
+            # calling in) — lexically outside the `with`, hence the
+            # suppression, but the witness test covers the pair live.
+            self._fenced = True  # dk: disable=DK202
+            if self._store is not None:
+                self._store.write_epoch(int(epoch), fenced=True)
+        if self._fenced:
+            return self._err("not_primary",
+                             f"fenced ex-primary (epoch {self.epoch})")
+        if epoch is not None and int(epoch) < self.epoch:
+            return self._err(
+                "epoch_fenced",
+                f"request epoch {int(epoch)} predates server epoch "
+                f"{self.epoch}: re-join the promoted primary")
+        return None
 
     def _stash_stripe(self, wid: int, seq: int, num_shards: int,
                       header: dict, arrays: list):
@@ -603,6 +810,9 @@ class PSServer:
         if wid is None:
             return self._err("protocol", "heartbeat requires worker_id")
         with self._lock:
+            err = self._check_primary_locked(header)
+            if err is not None:
+                return err
             if int(wid) not in self._members:
                 return self._err(
                     "lease_expired", f"worker {wid} is not a member")
@@ -615,6 +825,76 @@ class PSServer:
             if wid is not None:
                 self._members.pop(int(wid), None)
         return {"ok": True}, []
+
+    def _op_replicate(self, header: dict) -> tuple[dict, list]:
+        """One pull of the journal stream by a warm standby: ``u`` is the
+        next fold index the standby needs. Answers a batch of journal
+        records in wire form (``mode=records``; each record header carries
+        its array count ``k``, the deltas ride flattened), or — when the
+        standby is fresh (``u < 0``), behind the replication tail, or has
+        a gap — one full state sync (``mode=snapshot``). Served during
+        drain: a draining primary must still let its standby catch up."""
+        u = int(header.get("u", -1))
+        with self._lock:
+            if self._not_primary or self._fenced:
+                return self._err(
+                    "not_primary", "cannot replicate from a non-primary")
+            if self._center is None:
+                return self._err("uninitialized", "no center yet")
+            # First replicate turns the tail buffer on; until a standby
+            # exists no deployment pays its memory.
+            self._repl_on = True
+            recs = [r for r in self._repl if r["u"] >= u]
+            if u == self._updates:
+                recs = []
+            elif u < 0 or u > self._updates or not recs or recs[0]["u"] != u:
+                # Fresh standby / behind the tail / gap — or a standby
+                # AHEAD of this primary (a cold restart lost the journal
+                # tail the standby had already replicated): the primary's
+                # durable state is the authoritative lineage, so the
+                # answer is always one full state sync the standby adopts
+                # wholesale. The lost commits' workers were ACKed and
+                # never retransmit — the standard lost-window semantics,
+                # never a divergent fold.
+                hdr = {"ok": True, "mode": "snapshot",
+                       "updates": self._updates, "epoch": self.epoch,
+                       "lineage": self.lineage,
+                       "commits_total": self.commits_total,
+                       "last_seq": {str(k): int(v)
+                                    for k, v in self._last_seq.items()}}
+                return hdr, [a.copy() for a in self._center]
+            recs = recs[:_REPL_BATCH]
+            headers = [{"u": r["u"], "wid": r["wid"], "seq": r["seq"],
+                        "st": r["st"], "e": r["e"], "n": r["n"],
+                        "k": len(r["delta"])} for r in recs]
+            out: list = []
+            for r in recs:
+                out.extend(r["delta"])
+            return ({"ok": True, "mode": "records", "records": headers,
+                     "updates": self._updates, "epoch": self.epoch,
+                     "lineage": self.lineage}, out)
+
+    def _op_fence(self, header: dict) -> tuple[dict, list]:
+        """A promoted standby fencing the old lineage: an epoch strictly
+        above ours means we are the zombie — stop folding forever. An
+        epoch at or below ours is the *fencer* being stale (it is the
+        zombie); refuse with the typed fence error."""
+        try:
+            epoch = int(header["epoch"])
+        except (KeyError, TypeError, ValueError):
+            return self._err("protocol", "fence requires an integer epoch")
+        with self._lock:
+            if epoch > self.epoch:
+                self._fenced = True
+                if self._store is not None:
+                    # Durable: a fenced-then-restarted ex-primary comes
+                    # back refusing to fold, not serving the old epoch.
+                    self._store.write_epoch(epoch, fenced=True)
+                return {"ok": True, "fenced": True, "epoch": epoch}, []
+            return self._err(
+                "epoch_fenced",
+                f"fence epoch {epoch} does not exceed server epoch "
+                f"{self.epoch}")
 
 
 def serve(center: Optional[Sequence[np.ndarray]] = None,

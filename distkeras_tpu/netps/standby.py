@@ -1,0 +1,337 @@
+"""Warm-standby failover: a second PSServer tailing the primary's journal.
+
+The reference's parameter server was a single point of total state loss.
+:mod:`netps/state.py` fixes the *durability* half (a killed primary cold-
+restarts from its state dir); this module fixes the *availability* half: a
+:class:`StandbyServer` is a real :class:`~distkeras_tpu.netps.server.
+PSServer` that
+
+* **tails the primary's journal stream** over the existing wire protocol
+  (``replicate`` request frames — advertised by the ``replication`` bit in
+  :data:`~distkeras_tpu.netps.wire.CAPS`): each reply is a batch of folded
+  commits in their **wire dtype** (int8/bf16 specs included), re-folded
+  here through the ONE shared :func:`~distkeras_tpu.netps.fold.fold_delta`
+  with the recorded staleness, in the recorded order — so the standby's
+  center is the primary's center, bit for bit, at every replicated index.
+  A fresh (or gapped, or behind-the-tail) standby gets one full state
+  sync (``mode=snapshot``) and resumes incremental tailing from there.
+  Until it promotes it serves nothing: every client op answers the typed
+  ``not_primary`` and the hardened client walks its endpoint list onward.
+
+* **promotes itself when the primary's lease lapses**: no successful
+  replicate for ``promote_after`` seconds (default: the membership lease —
+  the same silence budget workers get) means the primary is gone. The
+  standby bumps the epoch past everything it ever replicated, persists the
+  promotion (``epoch.json`` in its state dir, if it has one), starts
+  serving, and **fences the old lineage**: a best-effort ``fence`` frame is
+  retried at the old primary for a while, and — belt to that suspender —
+  every join/commit reply now carries the new epoch, so a commit from the
+  old lineage answers ``EpochFencedError`` (never folded) and a zombie
+  ex-primary that sees a higher-epoch request fences *itself*. Zero
+  stale-epoch folds, whichever message arrives first.
+
+* keeps the replicated dedup table, so a worker whose commit was ACKed by
+  the dead primary retransmits to the promoted standby and is answered
+  ``duplicate=True`` — **exactly-once accounting rides through the
+  failover**; a commit the primary folded but never replicated is simply
+  lost with it (the client retransmits and it folds once, here).
+
+The split-brain caveat (documented in docs/RESILIENCE.md's failure-model
+matrix): promotion is lease-based, so a partition that separates the
+standby from a *healthy* primary promotes a second lineage. The epoch
+fence guarantees the center never mixes lineages — clients fold into
+exactly one epoch and the other side's commits are rejected typed — but
+which lineage survives is decided by which endpoints the clients can
+reach, not by a quorum this two-node design does not have.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from distkeras_tpu.netps import wire
+from distkeras_tpu.netps.errors import ProtocolError
+from distkeras_tpu.netps.fold import decode_entry, fold_delta
+from distkeras_tpu.netps.server import PSServer
+
+
+
+class StandbyServer(PSServer):
+    """A warm standby of the primary at ``primary_endpoint``.
+
+    Accepts everything a :class:`PSServer` does (``state_dir`` gives the
+    standby its own durable store, so a promoted-then-killed standby cold-
+    restarts fenced-forward); ``promote_after`` defaults to the membership
+    lease. ``start()`` begins replication; :attr:`promoted` flips once the
+    standby has taken over (and :attr:`epoch` then exceeds everything the
+    old lineage ever served).
+    """
+
+    def __init__(self, primary_endpoint: str, *,
+                 promote_after: Optional[float] = None,
+                 rpc_timeout: Optional[float] = None, **kw):
+        super().__init__(standby=True, **kw)
+        self.primary_endpoint = primary_endpoint
+        self.promote_after = float(promote_after if promote_after is not None
+                                   else self.lease_s)
+        #: per-replicate deadline: must resolve well inside the promotion
+        #: budget or a hung primary would stall the lapse detection.
+        self.rpc_timeout = float(rpc_timeout if rpc_timeout is not None
+                                 else max(0.2, self.promote_after / 3.0))
+        self.promoted = False
+        #: replicated commits applied / full snapshot syncs taken.
+        self.replicated = 0
+        self.snapshot_syncs = 0
+        #: the primary incarnation this standby's state descends from: a
+        #: change means the primary restarted and may have LOST journal
+        #: tail this standby already replicated — fold indices would line
+        #: up again while the histories differ, so the only safe move is
+        #: to discard local state and full-sync (primary is authoritative).
+        self._primary_lineage: Optional[str] = None
+        self._repl_thread: Optional[threading.Thread] = None
+        self._fence_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> "StandbyServer":
+        if self._started:
+            return self
+        super().start()
+        t = threading.Thread(target=self._replicate_loop,
+                             name="netps-standby-replicate")
+        t.start()
+        self._repl_thread = t
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in (self._repl_thread, self._fence_thread):
+            if t is not None:
+                t.join()
+        super().close()
+
+    # ------------------------------------------------------------------
+    def _replicate_loop(self) -> None:
+        """Tail the primary until promotion (or close). A plain socket —
+        not a PSClient — because the stream must arrive ``decode=False``:
+        replicated deltas re-fold in their wire dtype, the same arithmetic
+        the primary ran and the journal replay runs (bit-identical center
+        is the contract, and a dequantize-then-fold would break it in the
+        last ulp)."""
+        from distkeras_tpu import telemetry
+
+        sock: Optional[socket.socket] = None
+        req = 0
+        last_ok = time.monotonic()
+        tick = max(0.02, min(self.promote_after / 4.0, 0.25))
+        while not self._stop.is_set():
+            caught_up = True
+            try:
+                if sock is None:
+                    sock = socket.create_connection(
+                        wire.split_endpoint(self.primary_endpoint),
+                        timeout=self.rpc_timeout)
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                req += 1
+                sock.settimeout(self.rpc_timeout)
+                wire.send_frame(sock, wire.KIND_REQUEST,
+                                {"op": "replicate", "u": self._next_u(),
+                                 "req": req}, [])
+                rhdr, rarrays = self._recv_reply(sock, req)
+                err = rhdr.get("error")
+                if err in ("uninitialized",):
+                    # The primary is alive, just has no center yet.
+                    last_ok = time.monotonic()
+                elif err:
+                    # A typed rejection (not_primary: the primary itself
+                    # was fenced; protocol: a pre-replication peer). The
+                    # peer is alive — do not promote over it — but this
+                    # link cannot replicate; keep probing.
+                    telemetry.counter(
+                        "netps.failover.replicate_rejected").add(1)
+                    last_ok = time.monotonic()
+                else:
+                    caught_up = self._apply(rhdr, rarrays)
+                    last_ok = time.monotonic()
+            except (socket.timeout, ConnectionError, OSError,
+                    ProtocolError):
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    sock = None
+            if time.monotonic() - last_ok > self.promote_after:
+                self._promote()
+                break
+            if caught_up:
+                self._stop.wait(tick)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _recv_reply(self, sock: socket.socket, req: int):
+        """One matched reply, wire-dtype arrays (``decode=False``)."""
+        while True:
+            prefix = wire.recv_exact(sock, wire.PREFIX_SIZE)
+            kind, _n, rhdr, rarrays = wire.finish_frame(sock, prefix,
+                                                        decode=False)
+            if kind != wire.KIND_REPLY:
+                raise ProtocolError(f"expected a reply frame, got {kind}")
+            if rhdr.get("req") == req:
+                return rhdr, rarrays
+
+    def _next_u(self) -> int:
+        with self._lock:
+            # Until a snapshot sync has armed the lineage token, ask for a
+            # full sync even if we hold a center — a RESTARTED standby
+            # recovered its own durable state but cannot know whether the
+            # primary is still the incarnation that state descends from
+            # (same fold index, possibly different history); incremental
+            # tailing before the first sync would run with the divergence
+            # guard dark.
+            if self._center is None or self._primary_lineage is None:
+                return -1
+            return self._updates
+
+    def _apply(self, rhdr: dict, rarrays: list) -> bool:
+        """Apply one replicate reply; returns whether we are caught up
+        (False = a full batch arrived, pull again immediately)."""
+        from distkeras_tpu import telemetry
+
+        applied = 0
+        lineage = rhdr.get("lineage")
+        with self._lock:
+            self.epoch = max(self.epoch, int(rhdr.get("epoch", 0)))
+            if (rhdr.get("mode") != "snapshot"
+                    and self._primary_lineage is not None
+                    and lineage != self._primary_lineage):
+                # The primary restarted between replicates and our fold
+                # index happens to line up with its recovered one — same
+                # index, possibly different history (the bounded journal
+                # writer's tail died with the old incarnation). Discard
+                # and full-sync rather than fold a divergent record.
+                self._center = None
+                return False
+            if rhdr.get("mode") == "snapshot":
+                self._primary_lineage = lineage
+                self._center = [np.array(decode_entry(e), np.float32)
+                                for e in rarrays]
+                self._updates = int(rhdr["updates"])
+                self._last_seq = {int(k): int(v) for k, v in
+                                  (rhdr.get("last_seq") or {}).items()}
+                self._ever |= set(self._last_seq)
+                self.commits_total = int(rhdr.get("commits_total",
+                                                  self._updates))
+                # Wholesale adoption: any commit-log entries predate this
+                # sync's lineage (a lineage discard lands here) — they are
+                # not evidence about the adopted history, and keeping them
+                # could even drive _log_dropped negative.
+                self.commit_log.clear()
+                self._log_dropped = self.commits_total
+                self.snapshot_syncs += 1
+                if self._store is not None:
+                    self._snapshot_locked()
+                caught_up = True
+            else:
+                records = rhdr.get("records") or ()
+                off = 0
+                for rec in records:
+                    k = int(rec["k"])
+                    delta = rarrays[off:off + k]
+                    off += k
+                    if int(rec["u"]) != self._updates:
+                        # A gap (should be unreachable: we asked for our
+                        # exact index). Next pull requests a full sync.
+                        self._center = None
+                        break
+                    self._apply_record_locked(rec, delta)
+                    applied += 1
+                caught_up = len(records) < 1 or int(
+                    rhdr.get("updates", self._updates)) <= self._updates
+        if applied:
+            self.replicated += applied
+            telemetry.counter("netps.failover.replicated_commits").add(
+                applied)
+        return caught_up
+
+    def _apply_record_locked(self, rec: dict, delta: list) -> None:
+        """One journal record onto the local center (lock held) — the same
+        bookkeeping the primary's fold ran, including the standby's own
+        journal so a promoted-then-restarted standby recovers."""
+        wid, seq, st = int(rec["wid"]), int(rec["seq"]), int(rec["st"])
+        fold_delta(self._center, delta, self.discipline, st)
+        self.commit_log.append((wid, seq, st))
+        self._last_seq[wid] = seq
+        self._ever.add(wid)
+        self._updates += 1
+        self.commits_total = int(rec.get("n", self.commits_total + 1))
+        self.epoch = max(self.epoch, int(rec.get("e", 0)))
+        if self._store is not None:
+            self._store.append(epoch=self.epoch, wid=wid, seq=seq,
+                               staleness=st, updates=self._updates - 1,
+                               commits_total=self.commits_total,
+                               delta=delta)
+            if self._store.due(self._updates):
+                self._snapshot_locked()
+        self._trim_log_locked(2 * self._log_keep)
+
+    # ------------------------------------------------------------------
+    def _promote(self) -> None:
+        """Take over: bump the epoch past everything replicated, persist
+        it, start serving, and fence the old lineage best-effort."""
+        from distkeras_tpu import telemetry
+
+        with self._lock:
+            self.epoch += 1
+            self._not_primary = False
+            if self._store is not None:
+                self._store.write_epoch(self.epoch)
+            epoch = self.epoch
+            behind = self._center is None
+        self.promoted = True
+        telemetry.counter("netps.failover.promotions").add(1)
+        telemetry.event("netps_promotion", {
+            "epoch": epoch, "updates": self._updates,
+            "replicated": self.replicated, "cold": behind})
+        t = threading.Thread(target=self._fence_loop, args=(epoch,),
+                             name="netps-standby-fence")
+        t.start()
+        self._fence_thread = t
+
+    def _fence_loop(self, epoch: int) -> None:
+        """Fence the old primary for as long as this server lives. The
+        ex-primary may be dead (fencing a corpse is a no-op), mid-restart
+        (the whole point: catch it the moment it answers — a `Job` cold
+        restart can revive it MINUTES later, long after any bounded retry
+        budget would have given up, and a fresh client's join carries no
+        epoch for the passive check to catch), or reachable all along (a
+        partition only we fell on the wrong side of — then IT refuses our
+        fence typed, and we stop: we are the stale lineage there). A
+        landed fence persists in the zombie's state dir, but a STORELESS
+        zombie forgets it on restart — the periodic re-send re-fences it
+        within one interval, which is why the loop never ends on success."""
+        interval = max(0.1, self.promote_after)
+        while not self._stop.is_set():
+            try:
+                with socket.create_connection(
+                        wire.split_endpoint(self.primary_endpoint),
+                        timeout=self.rpc_timeout) as sock:
+                    wire.send_frame(sock, wire.KIND_REQUEST,
+                                    {"op": "fence", "epoch": epoch,
+                                     "req": 1}, [])
+                    sock.settimeout(self.rpc_timeout)
+                    rhdr, _ = self._recv_reply(sock, 1)
+                if rhdr.get("error"):
+                    return  # typed refusal: the peer outranks this epoch
+            except (socket.timeout, ConnectionError, OSError,
+                    ProtocolError):
+                pass
+            self._stop.wait(interval)

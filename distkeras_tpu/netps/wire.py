@@ -94,7 +94,11 @@ CODECS = (CODEC_NONE, CODEC_BF16, CODEC_INT8)
 #: with ``{"boot_id": ..., "uds": ...}`` (see ``netps/shm.py``) and the
 #: client upgrades only when the boot id matches its own — the same-host
 #: check that keeps a cross-host ``DKTPU_NET_TRANSPORT=shm`` on TCP.
-CAPS = {"codecs": list(CODECS), "striping": True, "shm": True}
+#: ``replication`` advertises the ``replicate``/``fence`` ops a warm
+#: standby tails the primary's journal stream through (``netps/standby.py``)
+#: — a peer without the bit gets a typed protocol rejection, never a hang.
+CAPS = {"codecs": list(CODECS), "striping": True, "shm": True,
+        "replication": True}
 
 
 # ---------------------------------------------------------------------------
@@ -289,8 +293,11 @@ def parse_prefix(prefix: bytes,
     return kind, crc, length
 
 
-def decode_frame(raw: bytes) -> tuple[int, dict, list[np.ndarray]]:
-    """Verify + decode one whole raw frame: ``(kind, header, arrays)``."""
+def decode_frame(raw: bytes,
+                 decode: bool = True) -> tuple[int, dict, list]:
+    """Verify + decode one whole raw frame: ``(kind, header, arrays)``.
+    ``decode=False`` returns ``(array, spec)`` wire pairs (the journal
+    replay path — replayed deltas must re-fold in their wire dtype)."""
     kind, crc, length = parse_prefix(raw[:PREFIX_SIZE],
                                      max_frame=len(raw))
     body = raw[PREFIX_SIZE:]
@@ -299,7 +306,7 @@ def decode_frame(raw: bytes) -> tuple[int, dict, list[np.ndarray]]:
             f"frame declares {length} body bytes, got {len(body)}")
     if zlib.crc32(body) != crc:
         raise ProtocolError("frame checksum mismatch (corrupt or truncated)")
-    header, arrays = _decode_body(body)
+    header, arrays = _decode_body(body, decode=decode)
     return kind, header, arrays
 
 
@@ -435,6 +442,19 @@ def send_frame(sock: socket.socket, kind: int, header: dict,
     return total
 
 
+def write_frame(fobj, kind: int, header: dict,
+                arrays: Sequence = ()) -> int:
+    """One frame appended to a binary file object, buffer by buffer (no
+    ``b"".join`` copy) — the durable journal's record writer
+    (``netps/state.py``). The frame self-validates on read via the same
+    crc/length checks the sockets use, so a torn tail (the process died
+    mid-append) is detected, not replayed."""
+    buffers, total = _frame_buffers(kind, header, arrays)
+    for b in buffers:
+        fobj.write(b)
+    return total
+
+
 def _sendmsg_all(sock: socket.socket, buffers: list) -> None:
     """``sendmsg`` the buffer list fully, re-slicing across partial sends
     and chunking at ``_IOV_MAX``; falls back to per-buffer ``sendall``
@@ -468,3 +488,15 @@ def split_endpoint(endpoint: str) -> tuple[str, int]:
         raise ValueError(
             f"malformed endpoint {endpoint!r}: expected 'host:port'")
     return host, int(port)
+
+
+def split_endpoints(endpoints: str) -> list[tuple[str, int]]:
+    """``"host:port[,host:port...]"`` -> ordered (host, port) list — the
+    client-failover form of ``DKTPU_PS_ENDPOINT`` (primary first, then
+    standbys in promotion-preference order). A single endpoint parses to a
+    one-element list, so every existing caller is unchanged."""
+    out = [split_endpoint(e.strip())
+           for e in endpoints.split(",") if e.strip()]
+    if not out:
+        raise ValueError(f"no endpoints in {endpoints!r}")
+    return out

@@ -58,7 +58,8 @@ _KINDS = frozenset({
 
 #: network fault kinds (``DKTPU_NET_FAULTS``), consumed by the netps chaos
 #: proxy (``netps/chaos.py``), the shared-memory ring transport
-#: (``netps/shm.py``), and the remote worker loop. ``at`` indexes
+#: (``netps/shm.py``), the netps server itself, and the remote worker
+#: loop. ``at`` indexes
 #: client->server *frames* for the wire kinds (TCP frames through the
 #: proxy; ring frames for the ``shm_*`` kinds — no proxy can sit on a
 #: memory ring, so the transport injects its own faults) and commit
@@ -66,11 +67,20 @@ _KINDS = frozenset({
 #: (server->client) direction of the same frame index — "per direction"
 #: fault injection. ``shm_delay@F:S`` holds ring frame F for S seconds;
 #: ``shm_corrupt@F`` flips frame F's slot crc so the server rejects it and
-#: the connection dies (the ring's ``truncate``).
+#: the connection dies (the ring's ``truncate``). ``ps_crash@R`` SIGKILLs
+#: the netps SERVER process just before folding its R-th commit (the
+#: kill-the-primary drill — recovery is the state-dir cold restart or the
+#: warm standby's promotion); ``ps_hang@R:S`` wedges the server for S
+#: seconds *holding its center lock* before commit R, so every member's
+#: lease renewal queues behind a genuinely hung PS (what ``Job.supervise``
+#: must tell apart from a draining one). Both are consumed by the server
+#: process, never by the proxy — schedule them only in the PS process's
+#: environment.
 _NET_KINDS = frozenset({
     "delay", "drop", "dup", "truncate", "partition", "evict",
     "delay_r", "drop_r", "dup_r", "truncate_r",
     "shm_delay", "shm_corrupt",
+    "ps_crash", "ps_hang",
 })
 
 
@@ -129,9 +139,15 @@ class FaultPlan:
         return cls(faults, seed=seed, state_file=state_file)
 
     @classmethod
-    def parse_net(cls, spec: str) -> "FaultPlan":
-        """Parse a network-fault plan (``DKTPU_NET_FAULTS`` grammar)."""
-        return cls.parse(spec, kinds=_NET_KINDS)
+    def parse_net(cls, spec: str,
+                  state_file: Optional[str] = None) -> "FaultPlan":
+        """Parse a network-fault plan (``DKTPU_NET_FAULTS`` grammar).
+        ``state_file`` journals fired faults across a process restart —
+        ``ps_crash@R`` restarts the very process consulting the plan, so
+        without it the restarted server would re-crash at R forever (the
+        ``kill@R`` problem, one subsystem over). The net and compute plans
+        may share one file: their kind names never collide."""
+        return cls.parse(spec, kinds=_NET_KINDS, state_file=state_file)
 
     @classmethod
     def from_env(cls) -> Optional["FaultPlan"]:
@@ -266,7 +282,13 @@ def active_net_plan() -> Optional[FaultPlan]:
         return None
     with _LOCK:
         if spec != _NET_CACHED_SPEC:
-            _NET_CACHED_PLAN = FaultPlan.parse_net(spec)
+            # The same fired-state journal as the compute plan: `ps_crash`
+            # restarts the process that consults this plan, exactly like
+            # `kill@R` does — without the journal the restarted server
+            # would re-crash at the same commit forever.
+            _NET_CACHED_PLAN = FaultPlan.parse_net(
+                spec, state_file=config.env_str("DKTPU_FAULTS_STATE")
+                or None)
             _NET_CACHED_SPEC = spec
         return _NET_CACHED_PLAN
 

@@ -40,6 +40,21 @@ def tree_digest(tree: Any) -> dict:
             "leaves": len(leaves), "bytes": total}
 
 
+def file_sha256(path: str, chunk: int = 1 << 20) -> str:
+    """Streaming sha256 of a file's bytes — the sidecar check for on-disk
+    blobs hashed as files rather than trees (the netps PS snapshots: the
+    server is numpy + stdlib only, so no ``jax.tree`` walk is available
+    there). Raises ``OSError`` if the file is unreadable."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
 def write_digest(path: str, digest: dict) -> None:
     """Atomic (tmp + rename) sidecar write."""
     tmp = path + ".tmp"

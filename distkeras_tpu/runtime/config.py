@@ -190,9 +190,31 @@ ENV_REGISTRY: dict = _declare(
            "is evicted and training continues with the survivors.",
            "network"),
     EnvVar("DKTPU_PS_ENDPOINT", "str", "",
-           "`host:port` of a running netps parameter server; async "
+           "Endpoint(s) of a running netps parameter server: `host:port`, "
+           "or a comma-separated `primary:port,standby:port` list the "
+           "client walks on failure/`not_primary` (failover); async "
            "trainers use it when `remote=` is not passed explicitly "
            "(`Job` sets it for every launched worker).",
+           "network"),
+    EnvVar("DKTPU_PS_STATE_DIR", "str", "",
+           "Directory for the netps server's durable state (write-ahead "
+           "commit journal + periodic center snapshots + sha256 sidecars); "
+           "a restarted server recovers center/counter/dedup state from it "
+           "and in-flight commits retransmit exactly-once. Empty = "
+           "in-memory only (a PS crash loses every fold).",
+           "network"),
+    EnvVar("DKTPU_PS_SNAPSHOT_EVERY", "int", 500,
+           "Folds between netps center snapshots when a state dir is set; "
+           "each snapshot rotates + compacts the journal, so on-disk state "
+           "stays bounded at ~2 snapshots plus the commits between them. "
+           "0 disables snapshots (journal-only, unbounded).",
+           "network"),
+    EnvVar("DKTPU_PS_STANDBY", "str", "",
+           "`host:port` of the PRIMARY a `python -m distkeras_tpu.netps` "
+           "process should run as a warm standby of: it tails the "
+           "primary's journal stream over the wire (`replicate` frames), "
+           "promotes itself when the primary's lease lapses, and fences "
+           "the old epoch. Empty = run as a primary.",
            "network"),
     EnvVar("DKTPU_NO_NATIVE", "bool", False,
            "`1` disables the native (C++) data-plane kernels; every gather "

@@ -21,6 +21,26 @@ counters rather than the root server's attributes::
     DKTPU_NET_TRANSPORT=shm DKTPU_NET_HIER=1 DKTPU_PS_LEASE=1.0 \\
     DKTPU_NET_FAULTS="shm_delay@3:0.2;shm_corrupt@6;evict@4:2.2;seed=3" \\
         python tests/smoke_netps_chaos.py
+
+**Kill-the-primary mode** (``DKTPU_PS_STATE_DIR`` set): the PS runs as a
+real subprocess (``python -m distkeras_tpu.netps --state-dir ...``) whose
+OWN fault plan SIGKILLs it mid-run (``ps_crash@R``), while this process's
+plan keeps driving the proxy (partition etc.). Recovery is either the
+cold restart (a babysitter thread relaunches the dead primary on the same
+state dir + port — ``Job.supervise``'s role, inlined) or, with
+``DKTPU_PS_STANDBY=1``, a warm standby subprocess that tails the journal,
+promotes on lease lapse, and fences the epoch; the trainer's clients walk
+the ``proxy,standby`` endpoint list. Exactly-once is asserted on the
+on-disk journals (the only view a subprocess leaves behind), and journal
+epochs must be nondecreasing — the zero-stale-epoch-folds evidence::
+
+    DKTPU_PS_STATE_DIR=/tmp/ps-state \\
+    DKTPU_NET_FAULTS="partition@16:0.8;seed=3" \\
+        python tests/smoke_netps_chaos.py          # cold-restart path
+    DKTPU_PS_STANDBY=1 DKTPU_PS_STATE_DIR=/tmp/ps-state ...  # failover path
+
+All seeds are pinned (data rng, trainer seed, fault-plan seeds, the
+``ps_crash`` commit index), so reruns schedule the same chaos.
 """
 
 import os
@@ -52,6 +72,151 @@ from distkeras_tpu import ADAG, DataFrame, telemetry  # noqa: E402
 from distkeras_tpu.models import Model  # noqa: E402
 from distkeras_tpu.models.mlp import MLP  # noqa: E402
 from distkeras_tpu.netps import ChaosProxy, PSServer  # noqa: E402
+from distkeras_tpu.netps import state as netps_state  # noqa: E402
+
+#: the primary subprocess's own fault plan: SIGKILL just before folding
+#: commit 20 (mid-run: the full run folds ~48). Pinned, not random.
+PS_FAULTS = os.environ.get("NETPS_SMOKE_PS_FAULTS", "ps_crash@20;seed=3")
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch_ps(port, state_dir, extra_env, *extra_args):
+    import subprocess
+
+    # The smoke process's own chaos plan and PS-role env must not leak
+    # into the server subprocess: it gets explicit flags + its OWN plan.
+    drop = {"DKTPU_NET_FAULTS", "DKTPU_PS_STANDBY", "DKTPU_PS_STATE_DIR",
+            "DKTPU_FAULTS_STATE"}
+    env = {k: v for k, v in os.environ.items() if k not in drop}
+    env.update({"JAX_PLATFORMS": "cpu", **extra_env})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distkeras_tpu.netps", "--host", "127.0.0.1",
+         "--port", str(port), "--discipline", "adag", "--lease", "1.0",
+         "--state-dir", state_dir, "--snapshot-every", "10", *extra_args],
+        env=env)
+    return proc
+
+
+def _assert_journal_invariants(state_dir, label):
+    """The subprocess-visible exactly-once + zero-stale-epoch evidence:
+    every (worker, seq) journaled at most once, fold indices strictly
+    sequential per journal chain, epochs nondecreasing."""
+    records = netps_state.read_journal(state_dir)
+    seen = set()
+    last_epoch = -1
+    for r in records:
+        key = (int(r["wid"]), int(r["seq"]))
+        assert key not in seen, f"{label}: commit {key} folded twice"
+        seen.add(key)
+        assert int(r["e"]) >= last_epoch, (
+            f"{label}: journal epoch went backwards at {key}")
+        last_epoch = int(r["e"])
+    return records, last_epoch
+
+
+def _run_failover(df, model) -> int:
+    """Kill-the-primary mode: PS subprocess(es) + ps_crash, with either a
+    babysitter cold restart or a warm-standby promotion riding it out."""
+    import subprocess
+    import threading
+    import time
+
+    state_dir = os.environ["DKTPU_PS_STATE_DIR"]
+    standby_mode = bool(os.environ.get("DKTPU_PS_STANDBY"))
+    port = _free_port()
+    faults_state = os.path.join(state_dir, "faults.journal")
+    os.makedirs(state_dir, exist_ok=True)
+    primary = _launch_ps(port, state_dir,
+                         {"DKTPU_NET_FAULTS": PS_FAULTS,
+                          "DKTPU_FAULTS_STATE": faults_state})
+    procs = [primary]
+    restarts = [0]
+    stop = threading.Event()
+    standby_dir = state_dir + ".standby"
+
+    def babysit():
+        # Job.supervise's PS-restart duty, inlined: relaunch the killed
+        # primary on the same state dir + port (cold recovery). The fired-
+        # faults journal keeps ps_crash one-shot across the restart.
+        nonlocal primary
+        while not stop.is_set():
+            if primary.poll() is not None and primary.returncode != 0:
+                restarts[0] += 1
+                primary = _launch_ps(
+                    port, state_dir,
+                    {"DKTPU_NET_FAULTS": PS_FAULTS,
+                     "DKTPU_FAULTS_STATE": faults_state})
+                procs.append(primary)
+            time.sleep(0.1)
+
+    standby = None
+    if standby_mode:
+        sb_port = _free_port()
+        standby = _launch_ps(sb_port, standby_dir, {},
+                             "--standby", f"127.0.0.1:{port}",
+                             "--promote-after", "1.5")
+        procs.append(standby)
+    else:
+        threading.Thread(target=babysit, daemon=True).start()
+    proxy = ChaosProxy(f"127.0.0.1:{port}").start()  # ambient net faults
+    endpoint = proxy.endpoint
+    if standby_mode:
+        endpoint = f"{endpoint},127.0.0.1:{sb_port}"
+    try:
+        trainer = ADAG(model, loss="sparse_categorical_crossentropy",
+                       num_workers=4, batch_size=16, num_epoch=3,
+                       learning_rate=0.1, communication_window=4,
+                       seed=0, remote=endpoint)
+        trained = trainer.train(df, shuffle=True)
+    finally:
+        stop.set()
+        proxy.close()
+        # Crash evidence is read BEFORE teardown: the escalation below can
+        # itself produce nonzero returncodes (SIGKILL on a wedged drain),
+        # which must never masquerade as the injected ps_crash.
+        crashed = any(p.poll() not in (0, None) for p in procs)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(timeout=10)
+    acc = float((np.asarray(trained.predict(jnp.asarray(
+        df["features"]))).argmax(-1) == df["label"]).mean())
+    reg = telemetry.get()
+    retries = reg.counter("netps.retries").value
+    walks = reg.counter("netps.endpoint_walks").value
+    records, last_epoch = _assert_journal_invariants(state_dir, "primary")
+    mode = "standby" if standby_mode else "cold-restart"
+    line = (f"netps kill-the-primary ({mode}): acc={acc:.4f} "
+            f"journaled={len(records)} restarts={restarts[0]} "
+            f"client_retries={retries:.0f} endpoint_walks={walks:.0f}")
+    if standby_mode:
+        sb_records, sb_epoch = _assert_journal_invariants(
+            standby_dir, "standby")
+        line += f" standby_journaled={len(sb_records)} epoch={sb_epoch}"
+        assert sb_epoch >= 1, "the standby never promoted past epoch 0"
+        assert walks >= 1, "no client ever walked the endpoint list"
+    else:
+        assert restarts[0] >= 1, "the primary was never killed + restarted"
+        assert last_epoch == 0, "cold restart must not change the epoch"
+    print(line)
+    assert crashed, "ps_crash never fired — the drill tested nothing"
+    assert acc > 0.85, f"accuracy collapsed across the PS crash: {acc}"
+    assert retries >= 1, "no RPC ever retried — chaos did not bite"
+    assert len(records) >= 10, "journal is implausibly short"
+    return 0
 
 
 def main() -> int:
@@ -63,13 +228,15 @@ def main() -> int:
                     "label": y.astype(np.int32)})
     model = Model.build(MLP(hidden=(16,), num_outputs=3),
                         jnp.zeros((1, 4), jnp.float32), seed=0)
+    if os.environ.get("DKTPU_PS_STATE_DIR"):
+        return _run_failover(df, model)
     server = PSServer(discipline="adag", lease_s=1.0).start()
     proxy = ChaosProxy(server.endpoint).start()  # ambient DKTPU_NET_FAULTS
     try:
         trainer = ADAG(model, loss="sparse_categorical_crossentropy",
                        num_workers=4, batch_size=16, num_epoch=3,
                        learning_rate=0.1, communication_window=4,
-                       remote=proxy.endpoint)
+                       seed=0, remote=proxy.endpoint)
         trained = trainer.train(df, shuffle=True)
     finally:
         proxy.close()

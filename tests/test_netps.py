@@ -666,6 +666,61 @@ def test_rpc_telemetry_spans_and_counters_recorded():
         telemetry.reset()
 
 
+def test_drain_vs_eviction_race_no_resurrection_no_deadlock():
+    """Lease expiry firing while ``drain()`` is mid-flight must not
+    resurrect an evicted worker or deadlock the monitor thread — the
+    drain flag and the eviction sweep share ONE lock, witnessed live per
+    DK201. Draining deliberately rejects commits BEFORE the lease renewal
+    would run, and a draining join answers typed, so the only door back
+    in is closed both ways."""
+    import time as _time
+
+    from distkeras_tpu.analysis import witness
+
+    with witness() as w:
+        srv = make_server(lease_s=0.15)
+        keeper = PSClient(srv.endpoint, worker_id=0, **FAST)
+        sleeper = PSClient(srv.endpoint, worker_id=1, auto_rejoin=False,
+                           **FAST)
+        try:
+            _, upd = keeper.join(init=[np.zeros(4, np.float32)])
+            sleeper.join()
+            stop = threading.Event()
+
+            def drainer():
+                # drain() repeatedly while the monitor's eviction sweep
+                # races it over the same lock.
+                while not stop.is_set():
+                    srv.drain()
+                    _time.sleep(0.01)
+
+            t = threading.Thread(target=drainer)
+            t.start()
+            deadline = _time.monotonic() + 5.0
+            while 1 in srv.members() and _time.monotonic() < deadline:
+                _time.sleep(0.02)
+            stop.set()
+            t.join()
+            assert 1 not in srv.members(), "eviction lost to the drain race"
+            assert srv.evictions >= 1
+            # The evicted worker cannot be resurrected through a draining
+            # server: join is typed-rejected, commit never renews.
+            with pytest.raises(ServerDrainingError):
+                sleeper.join()
+            assert 1 not in srv.members()
+            with pytest.raises(ServerDrainingError):
+                keeper.commit([np.ones(4, np.float32)], upd)
+            closer = threading.Thread(target=srv.close)
+            closer.start()
+            closer.join(timeout=10.0)
+            assert not closer.is_alive(), (
+                "close() deadlocked against the monitor thread")
+        finally:
+            keeper.close()
+            sleeper.close()
+    w.assert_no_inversions()
+
+
 # ---------------------------------------------------------------------------
 # Lock discipline: the witness over genuinely racing handler threads
 # ---------------------------------------------------------------------------

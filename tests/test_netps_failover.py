@@ -1,0 +1,690 @@
+"""Surviving the parameter server: durable center state (journal +
+snapshots + newest-intact-first recovery), warm-standby failover with
+epoch fencing, client endpoint-list walking, and the PS-side chaos kinds.
+
+The headline guarantees pinned here:
+
+* **Bit-identical recovery** — a killed server relaunched on its state
+  dir replays snapshot + journal to EXACTLY the pre-crash center (f32 and
+  compressed-domain int8 commits alike), resumes the update counter, and
+  answers joins with the last folded seq per worker so retransmits dedup.
+* **Zero stale-epoch folds** — a promoted standby fences the old lineage:
+  stale-epoch commits answer typed ``EpochFencedError`` and are never
+  folded; a zombie ex-primary fences ITSELF on sight of a higher epoch.
+* **Exactly-once across failover** — the replicated dedup table answers a
+  pre-crash commit's retransmit ``duplicate=True`` on the new primary.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.netps import (
+    EpochFencedError,
+    NotPrimaryError,
+    PSClient,
+    PSServer,
+    StandbyServer,
+)
+from distkeras_tpu.netps import state as netps_state
+from distkeras_tpu.netps import wire
+from distkeras_tpu.resilience.faults import FaultPlan
+
+FAST = dict(timeout=1.0, retries=3, backoff=0.01)
+
+
+def leaves():
+    rng = np.random.default_rng(7)
+    return [rng.normal(size=(4, 3)).astype(np.float32),
+            rng.normal(size=(8,)).astype(np.float32)]
+
+
+def drive_commits(endpoint, n, *, compress="none", worker_id=0, **kw):
+    """Join + fold ``n`` deterministic commits; returns the client's view
+    of the final (center, updates)."""
+    rng = np.random.default_rng(worker_id + 1)
+    c = PSClient(endpoint, worker_id=worker_id, compress=compress,
+                 **dict(FAST, **kw))
+    try:
+        center, upd = c.join(init=leaves())
+        for _ in range(n):
+            delta = [rng.normal(scale=0.1, size=a.shape).astype(np.float32)
+                     for a in center]
+            c.commit(delta, upd)
+            center, upd = c.pull()
+        return center, upd
+    finally:
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# Durability: journal + snapshots + recovery
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("compress", ["none", "int8"])
+def test_restart_replays_snapshot_plus_journal_bit_identically(
+        tmp_path, compress):
+    """THE parity pin: recovery replays journal records in their wire
+    dtype with the recorded staleness, so the recovered center equals the
+    pre-crash center bit for bit — including int8 compressed-domain folds,
+    which must re-fold exactly as they first folded."""
+    d = str(tmp_path / "state")
+    srv = PSServer(discipline="adag", state_dir=d, snapshot_every=4).start()
+    try:
+        drive_commits(srv.endpoint, 10, compress=compress)
+        pre = srv.center()
+        pre_updates, pre_total = srv.updates, srv.commits_total
+        pre_seq = dict(srv._last_seq)
+    finally:
+        srv.close()
+    srv2 = PSServer(discipline="adag", state_dir=d)
+    try:
+        post = srv2.center()
+        assert srv2.updates == pre_updates
+        assert srv2.commits_total == pre_total
+        assert srv2._last_seq == pre_seq
+        for a, b in zip(pre, post):
+            assert a.tobytes() == b.tobytes(), "recovery is not bit-identical"
+        # The commit-log bound invariant survives recovery too.
+        assert len(srv2.commit_log) + srv2._log_dropped == srv2.commits_total
+    finally:
+        srv2.close()
+
+
+def test_restarted_server_answers_join_with_last_seq_and_dedups(tmp_path):
+    """In-flight commits retransmit exactly-once across a PS restart: the
+    recovered dedup table answers the resumed worker's join with its last
+    folded seq, and a retransmit of an already-folded seq never re-folds."""
+    d = str(tmp_path / "state")
+    srv = PSServer(discipline="adag", state_dir=d).start()
+    try:
+        center, upd = drive_commits(srv.endpoint, 3)
+    finally:
+        srv.close()
+    srv2 = PSServer(discipline="adag", state_dir=d).start()
+    try:
+        c = PSClient(srv2.endpoint, worker_id=0, **FAST)
+        try:
+            _, upd = c.join()
+            assert c._seq == 2  # resumed past the server's folded history
+            before = srv2.center()
+            c._seq = 1  # retransmit of an ACKed pre-crash commit
+            res = c.commit([np.ones_like(a) for a in before], upd)
+            assert res.duplicate and not res.applied
+            after = srv2.center()
+            for a, b in zip(before, after):
+                assert a.tobytes() == b.tobytes(), "dedup'd commit folded"
+            res = c.commit([np.zeros_like(a) for a in before], upd)
+            assert res.applied  # the NEXT seq folds normally
+        finally:
+            c.close()
+    finally:
+        srv2.close()
+
+
+def test_torn_journal_tail_is_dropped_not_replayed(tmp_path):
+    d = str(tmp_path / "state")
+    srv = PSServer(discipline="adag", state_dir=d, snapshot_every=0).start()
+    try:
+        drive_commits(srv.endpoint, 4)
+    finally:
+        srv.close()
+    journals = sorted(p for p in os.listdir(d) if p.endswith(".dkj"))
+    path = os.path.join(d, journals[-1])
+    whole = open(path, "rb").read()
+    open(path, "wb").write(whole[:-7])  # the crash-interrupted append
+    srv2 = PSServer(discipline="adag", state_dir=d)
+    try:
+        # 1 base snapshot + 3 intact records; the torn 4th is detected by
+        # the frame crc and dropped, never folded as garbage.
+        assert srv2.updates == 3
+    finally:
+        srv2.close()
+
+
+def test_torn_interior_journal_still_replays_the_anchored_chain(tmp_path):
+    """TWO crashes between snapshots: the first leaves a torn tail in a
+    journal that is no longer the last one by the time the second crash's
+    recovery runs. The torn journal's valid prefix must still replay (it
+    anchors the NEXT journal), and rotation must never truncate it —
+    discarding it wholesale would regress the center to the snapshot,
+    losing durably-written ACKed commits far beyond the documented
+    bounded-writer window."""
+    d = str(tmp_path / "state")
+    srv = PSServer(discipline="adag", state_dir=d, snapshot_every=4).start()
+    try:
+        drive_commits(srv.endpoint, 6)  # snapshot at 4; journal-4: u=4,5
+    finally:
+        srv.close()
+    path = os.path.join(d, "journal-" + "4".zfill(12) + ".dkj")
+    with open(path, "rb") as f:  # crash #1's tear: keep only u=4
+        prefix = f.read(wire.PREFIX_SIZE)
+        _k, _c, length = wire.parse_prefix(prefix)
+        first = prefix + f.read(length)
+    open(path, "wb").write(first + b"\x13torn")
+    srv2 = PSServer(discipline="adag", state_dir=d).start()
+    try:
+        assert srv2.updates == 5  # snapshot 4 + journal-4's valid prefix
+        drive_commits(srv2.endpoint, 2, worker_id=1)  # journal-5: u=5,6
+        assert srv2.updates == 7
+        pre = srv2.center()
+    finally:
+        srv2.close()  # crash #2: journal-4 still carries its torn tail
+    srv3 = PSServer(discipline="adag", state_dir=d)
+    try:
+        assert srv3.updates == 7, (
+            "torn interior journal cost the anchored chain after it")
+        for a, b in zip(pre, srv3.center()):
+            assert a.tobytes() == b.tobytes()
+    finally:
+        srv3.close()
+
+
+def test_corrupt_snapshot_falls_back_to_previous_generation(tmp_path):
+    d = str(tmp_path / "state")
+    srv = PSServer(discipline="adag", state_dir=d, snapshot_every=3).start()
+    try:
+        drive_commits(srv.endpoint, 7)
+        pre = srv.center()
+    finally:
+        srv.close()
+    snaps = sorted(p for p in os.listdir(d) if p.endswith(".dks"))
+    assert len(snaps) == 2  # pruned to the newest two generations
+    newest = os.path.join(d, snaps[-1])
+    blob = bytearray(open(newest, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(newest, "wb").write(bytes(blob))
+    srv2 = PSServer(discipline="adag", state_dir=d)
+    try:
+        # Digest sidecar rejects the newest; the previous snapshot plus a
+        # LONGER journal replay still lands on the same center.
+        assert srv2.updates == 7
+        for a, b in zip(pre, srv2.center()):
+            assert a.tobytes() == b.tobytes()
+    finally:
+        srv2.close()
+
+
+def test_snapshot_compaction_bounds_disk_and_commit_log(tmp_path):
+    d = str(tmp_path / "state")
+    srv = PSServer(discipline="adag", state_dir=d, snapshot_every=5,
+                   commit_log_keep=6).start()
+    try:
+        drive_commits(srv.endpoint, 23)
+        snaps = [p for p in os.listdir(d) if p.endswith(".dks")]
+        journals = [p for p in os.listdir(d) if p.endswith(".dkj")]
+        assert len(snaps) <= 2, snaps
+        assert len(journals) <= 3, journals
+        assert len(srv.commit_log) <= 2 * 6
+        assert len(srv.commit_log) + srv._log_dropped == srv.commits_total
+        assert srv.commits_total == 23  # the drain-time count stays exact
+    finally:
+        srv.close()
+
+
+def test_read_journal_exposes_fold_order_evidence(tmp_path):
+    d = str(tmp_path / "state")
+    srv = PSServer(discipline="adag", state_dir=d, snapshot_every=4).start()
+    try:
+        drive_commits(srv.endpoint, 6)
+    finally:
+        srv.close()
+    records = netps_state.read_journal(d)
+    assert [int(r["u"]) for r in records] == sorted(
+        int(r["u"]) for r in records)
+    seen = {(int(r["wid"]), int(r["seq"])) for r in records}
+    assert len(seen) == len(records), "a commit was journaled twice"
+
+
+# ---------------------------------------------------------------------------
+# Epoch fencing
+# ---------------------------------------------------------------------------
+
+def test_stale_epoch_commit_is_fenced_never_folded():
+    srv = PSServer(discipline="adag").start()
+    try:
+        c = PSClient(srv.endpoint, worker_id=0, auto_rejoin=False, **FAST)
+        try:
+            center, upd = c.join(init=leaves())
+            assert c.epoch == 0
+            with srv._lock:
+                srv.epoch = 3  # a promotion happened somewhere
+            before = srv.center()
+            with pytest.raises(EpochFencedError):
+                c.commit([np.ones_like(a) for a in center], upd)
+            for a, b in zip(before, srv.center()):
+                assert a.tobytes() == b.tobytes(), "stale-epoch commit folded"
+        finally:
+            c.close()
+        # auto_rejoin client: fenced reads like evicted — discard window,
+        # re-join, adopt the new epoch, continue.
+        c2 = PSClient(srv.endpoint, worker_id=1, **FAST)
+        try:
+            center, upd = c2.join()
+            c2.epoch = 0  # stale lineage
+            res = c2.commit([np.zeros_like(a) for a in center], upd)
+            assert res.evicted and not res.applied
+            assert c2.epoch == 3
+            res = c2.commit([np.zeros_like(a) for a in center], upd)
+            assert res.applied
+        finally:
+            c2.close()
+    finally:
+        srv.close()
+
+
+def test_fence_op_and_higher_epoch_commit_both_fence_the_zombie():
+    srv = PSServer(discipline="adag").start()
+    try:
+        c = PSClient(srv.endpoint, worker_id=0, auto_rejoin=False, **FAST)
+        try:
+            center, upd = c.join(init=leaves())
+            # The passive fence: a commit carrying a HIGHER epoch is proof
+            # of a promotion — the server fences itself on the spot.
+            c.epoch = 5
+            with pytest.raises(NotPrimaryError):
+                c.commit([np.ones_like(a) for a in center], upd)
+            assert srv._fenced
+        finally:
+            c.close()
+    finally:
+        srv.close()
+    # The active fence: the replicate/fence op pair.
+    srv2 = PSServer(discipline="adag").start()
+    try:
+        with socket.create_connection(
+                wire.split_endpoint(srv2.endpoint), timeout=2.0) as s:
+            wire.send_frame(s, wire.KIND_REQUEST,
+                            {"op": "fence", "epoch": 2, "req": 1}, [])
+            s.settimeout(2.0)
+            _, hdr, _ = wire.read_frame(s)
+            assert hdr.get("fenced")
+        assert srv2._fenced
+        with pytest.raises(NotPrimaryError):
+            PSClient(srv2.endpoint, worker_id=1, auto_rejoin=False,
+                     **FAST).join(init=leaves())
+        # A fence that does NOT outrank the server is refused typed — the
+        # fencer is the zombie, not us.
+        srv3 = PSServer(discipline="adag", epoch=9).start()
+        try:
+            with socket.create_connection(
+                    wire.split_endpoint(srv3.endpoint), timeout=2.0) as s:
+                wire.send_frame(s, wire.KIND_REQUEST,
+                                {"op": "fence", "epoch": 2, "req": 1}, [])
+                s.settimeout(2.0)
+                _, hdr, _ = wire.read_frame(s)
+                assert hdr.get("error") == "epoch_fenced"
+            assert not srv3._fenced
+        finally:
+            srv3.close()
+    finally:
+        srv2.close()
+
+
+# ---------------------------------------------------------------------------
+# Warm standby: replication, promotion, failover
+# ---------------------------------------------------------------------------
+
+def _wait(predicate, timeout=6.0, tick=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(tick)
+    return False
+
+
+def test_fenced_ex_primary_with_state_dir_restarts_fenced(tmp_path):
+    """The fence is durable: a zombie ex-primary restarted from its state
+    dir (e.g. by `Job._revive_ps`, minutes after the failover) must come
+    back REFUSING to fold — a fresh client's join carries no epoch, so
+    without the persisted marker it would happily join the old lineage
+    and reopen the split brain the fence closed."""
+    d = str(tmp_path / "state")
+    srv = PSServer(discipline="adag", state_dir=d).start()
+    try:
+        drive_commits(srv.endpoint, 2)
+        with socket.create_connection(
+                wire.split_endpoint(srv.endpoint), timeout=2.0) as s:
+            wire.send_frame(s, wire.KIND_REQUEST,
+                            {"op": "fence", "epoch": 3, "req": 1}, [])
+            s.settimeout(2.0)
+            _, hdr, _ = wire.read_frame(s)
+            assert hdr.get("fenced")
+    finally:
+        srv.close()
+    back = PSServer(discipline="adag", state_dir=d).start()
+    try:
+        assert back._fenced, "the fence did not survive the restart"
+        with pytest.raises(NotPrimaryError):
+            PSClient(back.endpoint, worker_id=7, auto_rejoin=False,
+                     **FAST).join(init=leaves())
+    finally:
+        back.close()
+
+
+def test_standby_replicates_bit_identically_and_serves_nothing():
+    srv = PSServer(discipline="adag", lease_s=1.0).start()
+    sb = StandbyServer(srv.endpoint, discipline="adag", lease_s=1.0,
+                       promote_after=30.0).start()
+    try:
+        drive_commits(srv.endpoint, 6, compress="int8")
+        assert _wait(lambda: sb.updates == srv.updates)
+        for a, b in zip(srv.center(), sb.center()):
+            assert a.tobytes() == b.tobytes(), "replication drifted"
+        assert sb._last_seq == srv._last_seq
+        # Pre-promotion it serves nothing: the typed walk signal.
+        with pytest.raises(NotPrimaryError):
+            PSClient(sb.endpoint, worker_id=9, auto_rejoin=False,
+                     **FAST).join(init=leaves())
+        assert not sb.promoted
+    finally:
+        sb.close()
+        srv.close()
+
+
+def test_kill_primary_standby_promotes_client_walks_exactly_once():
+    """The in-process kill-the-primary drill: clients on the endpoint
+    LIST ride through the primary's death — the standby promotes on lease
+    lapse, fences the epoch, the client walks/re-joins/reconciles seq, and
+    a pre-crash commit's retransmit dedups on the new primary."""
+    srv = PSServer(discipline="adag", lease_s=0.5).start()
+    sb = StandbyServer(srv.endpoint, discipline="adag", lease_s=0.5,
+                       promote_after=0.6).start()
+    endpoints = f"{srv.endpoint},{sb.endpoint}"
+    c = PSClient(endpoints, worker_id=0, timeout=0.5, retries=10,
+                 backoff=0.02)
+    try:
+        center, upd = c.join(init=leaves())
+        rng = np.random.default_rng(3)
+        for _ in range(5):
+            delta = [rng.normal(scale=0.1, size=a.shape).astype(np.float32)
+                     for a in center]
+            c.commit(delta, upd)
+            center, upd = c.pull()
+        assert _wait(lambda: sb.updates == srv.updates)
+        pre_crash = srv.center()
+        srv.close()  # the primary dies mid-run
+        assert _wait(lambda: sb.promoted)
+        assert sb.epoch == 1
+        # The standby starts from the primary's exact final center.
+        for a, b in zip(pre_crash, sb.center()):
+            assert a.tobytes() == b.tobytes()
+        # The client's next RPC walks the list, re-joins, adopts epoch 1.
+        center, upd = c.pull()
+        assert c.epoch == 1 and c.rejoin_count >= 1
+        # Retransmit of a pre-crash seq: the REPLICATED dedup table
+        # answers duplicate — exactly-once rides through the failover.
+        c._seq -= 1
+        res = c.commit([np.ones_like(a) for a in center], upd)
+        assert res.duplicate and not res.applied
+        res = c.commit([np.zeros_like(a) for a in center], upd)
+        assert res.applied
+        seen = set()
+        for wid, seq, _st in sb.commit_log:
+            assert (wid, seq) not in seen, f"({wid},{seq}) folded twice"
+            seen.add((wid, seq))
+    finally:
+        c.close()
+        sb.close()
+
+
+def test_promoted_standby_with_state_dir_restarts_fenced_forward(tmp_path):
+    srv = PSServer(discipline="adag", lease_s=0.5).start()
+    d = str(tmp_path / "sb-state")
+    sb = StandbyServer(srv.endpoint, discipline="adag", lease_s=0.5,
+                       promote_after=0.6, state_dir=d).start()
+    try:
+        drive_commits(srv.endpoint, 3)
+        assert _wait(lambda: sb.updates == srv.updates)
+        srv.close()
+        assert _wait(lambda: sb.promoted)
+        drive_commits(sb.endpoint, 2, worker_id=1)
+        pre, pre_epoch, pre_updates = sb.center(), sb.epoch, sb.updates
+    finally:
+        sb.close()
+    # A promoted-then-killed standby cold-restarts AT its promoted epoch
+    # (the epoch.json marker), not the replicated one — the old lineage
+    # stays fenced across the restart.
+    back = PSServer(discipline="adag", state_dir=d)
+    try:
+        assert back.epoch == pre_epoch == 1
+        assert back.updates == pre_updates
+        for a, b in zip(pre, back.center()):
+            assert a.tobytes() == b.tobytes()
+    finally:
+        back.close()
+
+
+def test_client_endpoint_list_walks_past_dead_endpoints():
+    # Reserve a port that is genuinely closed.
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead = f"127.0.0.1:{probe.getsockname()[1]}"
+    probe.close()
+    srv = PSServer(discipline="adag").start()
+    try:
+        c = PSClient(f"{dead},{srv.endpoint}", worker_id=0,
+                     timeout=0.3, retries=4, backoff=0.01)
+        try:
+            center, upd = c.join(init=leaves())
+            assert c.commit([np.zeros_like(a) for a in center], upd).applied
+        finally:
+            c.close()
+        assert wire.split_endpoints(f"{dead},{srv.endpoint}") == [
+            wire.split_endpoint(dead), wire.split_endpoint(srv.endpoint)]
+        with pytest.raises(ValueError):
+            wire.split_endpoints(" , ")
+    finally:
+        srv.close()
+
+
+def test_standby_resyncs_when_restarted_primary_lost_its_tail(tmp_path):
+    """A cold-restarted primary may have LOST the journal tail the standby
+    already replicated (the bounded writer queue died with it) — fold
+    indices line up again while the histories differ. The per-incarnation
+    lineage token (and the ahead-of-primary snapshot sync) forces the
+    standby to discard and re-adopt the PRIMARY's authoritative state
+    instead of ever folding a divergent record."""
+    d = str(tmp_path / "state")
+    srv = PSServer(discipline="adag", lease_s=1.0, state_dir=d,
+                   snapshot_every=0).start()
+    port = int(srv.endpoint.rsplit(":", 1)[1])
+    sb = StandbyServer(srv.endpoint, discipline="adag", lease_s=1.0,
+                       promote_after=30.0).start()
+    try:
+        drive_commits(srv.endpoint, 5)
+        assert _wait(lambda: sb.updates == srv.updates == 5)
+        srv.close()
+        # Doctor the dir: drop the last 2 journal records — the writer
+        # tail that "died with the process".
+        journals = sorted(p for p in os.listdir(d) if p.endswith(".dkj"))
+        path = os.path.join(d, journals[-1])
+        nrec, clean = netps_state._scan_journal(path)
+        assert clean and nrec == 5
+        keep = bytearray()
+        with open(path, "rb") as f:
+            for _ in range(3):
+                prefix = f.read(wire.PREFIX_SIZE)
+                _k, _c, length = wire.parse_prefix(prefix)
+                keep += prefix + f.read(length)
+        open(path, "wb").write(bytes(keep))
+        # Cold restart on the same port: recovers at u=3, standby sits at 5.
+        srv2 = PSServer(discipline="adag", lease_s=1.0, state_dir=d,
+                        host="127.0.0.1", port=port).start()
+        try:
+            assert srv2.updates == 3
+            # The standby must CONVERGE DOWN to the primary's state.
+            assert _wait(lambda: sb.updates == 3 and sb._center is not None)
+            for a, b in zip(srv2.center(), sb.center()):
+                assert a.tobytes() == b.tobytes(), (
+                    "standby diverged from the restarted primary")
+            # The evidence accounting survives the lineage discard too.
+            assert sb._log_dropped >= 0
+            assert (len(sb.commit_log) + sb._log_dropped
+                    == sb.commits_total)
+            # And keep tracking the new lineage.
+            drive_commits(srv2.endpoint, 2, worker_id=1)
+            assert _wait(lambda: sb.updates == srv2.updates == 5)
+            for a, b in zip(srv2.center(), sb.center()):
+                assert a.tobytes() == b.tobytes()
+        finally:
+            srv2.close()
+    finally:
+        sb.close()
+
+
+def test_failover_patience_bridges_promotion_beyond_retry_budget():
+    """With standbys configured the retry budget alone must not decide
+    survival: a client with retries=1 (whose strict budget is far shorter
+    than the promotion window) keeps walking the endpoint list until the
+    standby promotes, because multi-endpoint RPCs get the failover
+    patience window (~2x lease) on top of the attempt budget."""
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead = f"127.0.0.1:{probe.getsockname()[1]}"
+    probe.close()
+    sb = StandbyServer(dead, discipline="adag", lease_s=1.0,
+                       promote_after=1.0).start()
+    try:
+        t0 = time.monotonic()
+        c = PSClient(f"{dead},{sb.endpoint}", worker_id=0,
+                     timeout=0.3, retries=1, backoff=0.02)
+        try:
+            center, upd = c.join(init=leaves())
+            took = time.monotonic() - t0
+            assert sb.promoted
+            assert c.commit([np.zeros_like(a) for a in center],
+                            upd).applied
+            # Sanity: this took longer than the strict 2-attempt budget
+            # (~0.7 s) could ever have survived.
+            assert took > 0.9, took
+        finally:
+            c.close()
+    finally:
+        sb.close()
+
+
+def test_revive_ps_skips_clean_exit_restarts_crash(monkeypatch):
+    from distkeras_tpu.job_deployment import Job, Punchcard
+
+    job = Job(Punchcard(job_name="j", script="s.py", hosts=["localhost"],
+                        ps={"state_dir": "/tmp/x"}))
+
+    class Fake:
+        def __init__(self, rc):
+            self.returncode = rc
+
+        def poll(self):
+            return self.returncode
+
+    spawned = []
+    monkeypatch.setattr(job, "_spawn_cmd",
+                        lambda host, cmd: spawned.append(cmd) or Fake(None))
+    # Clean drain (rc 0): deliberate stop, never revived.
+    job._ps_proc = Fake(0)
+    job._revive_ps(max_restarts=3)
+    assert job.ps_restarts == 0 and not spawned
+    # Crash (rc -9): revived, bounded by the budget.
+    job._ps_proc = Fake(-9)
+    job._revive_ps(max_restarts=3)
+    assert job.ps_restarts == 1 and len(spawned) == 1
+    assert "--state-dir" in spawned[0]
+
+
+# ---------------------------------------------------------------------------
+# PS-side chaos kinds + CLI signal contract
+# ---------------------------------------------------------------------------
+
+def test_ps_crash_and_hang_fault_kinds_parse_and_hang_fires():
+    plan = FaultPlan.parse_net("ps_crash@9;ps_hang@1:0.3;seed=2")
+    assert plan.faults[("ps_crash", 9)] is None
+    assert plan.faults[("ps_hang", 1)] == 0.3
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultPlan.parse_net("ps_reboot@3")
+    from distkeras_tpu.resilience import faults as _faults
+
+    srv = PSServer(discipline="adag").start()
+    _faults.set_net_plan(plan)
+    try:
+        c = PSClient(srv.endpoint, worker_id=0, timeout=5.0, retries=0,
+                     backoff=0.01)
+        try:
+            center, upd = c.join(init=leaves())
+            c.commit([np.zeros_like(a) for a in center], upd)  # commit 0
+            t0 = time.monotonic()
+            c.commit([np.zeros_like(a) for a in center], upd)  # commit 1
+            assert time.monotonic() - t0 >= 0.3, (
+                "ps_hang did not wedge the server")
+        finally:
+            c.close()
+    finally:
+        _faults.set_net_plan(None)
+        _faults.reset()
+        srv.close()
+
+
+@pytest.mark.slow
+def test_cli_second_sigterm_force_exits_nonzero(tmp_path):
+    """The __main__ signal contract: the FIRST SIGTERM prints
+    NETPS_DRAINING at signal time and drains; a SECOND mid-drain
+    force-exits nonzero instead of being swallowed — here the drain is
+    genuinely wedged by a half-sent frame holding a handler thread."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distkeras_tpu.netps", "--host", "127.0.0.1",
+         "--port", "0"],
+        stdout=subprocess.PIPE, env=env, text=True)
+    try:
+        ready = proc.stdout.readline()
+        assert ready.startswith("NETPS_READY "), ready
+        endpoint = ready.split()[1]
+        # Wedge a handler mid-frame: prefix promises a body that never
+        # arrives, so close() blocks joining that thread (~30 s).
+        s = socket.create_connection(wire.split_endpoint(endpoint))
+        frame = wire.encode_frame(wire.KIND_REQUEST, {"op": "pull"}, [])
+        s.sendall(frame[:wire.PREFIX_SIZE])
+        proc.send_signal(signal.SIGTERM)
+        line = proc.stdout.readline()
+        assert line.strip() == "NETPS_DRAINING", line
+        assert proc.poll() is None  # draining, not dead, not hung-silent
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=10)
+        assert rc == 70, f"second SIGTERM did not force-exit: rc={rc}"
+        s.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def test_punchcard_renders_the_ps_pair_and_endpoint_list():
+    from distkeras_tpu.job_deployment import Job, Punchcard
+
+    pc = Punchcard(job_name="j", script="train.py",
+                   hosts=["10.0.0.1", "10.0.0.2"],
+                   ps={"discipline": "adag", "port": 7171, "lease": 5.0,
+                       "state_dir": "/var/dktpu/ps",
+                       "standby_host": "10.0.0.2"})
+    assert pc.ps_endpoint() == "10.0.0.1:7171,10.0.0.2:7172"
+    job = Job(pc)
+    ps_cmd = job.render_ps_command()
+    assert "--state-dir /var/dktpu/ps" in ps_cmd
+    sb_cmd = job.render_standby_command()
+    assert "--standby 10.0.0.1:7171" in sb_cmd
+    assert "--port 7172" in sb_cmd
+    assert "--state-dir /var/dktpu/ps.standby" in sb_cmd
+    for cmd in job.launch(dry_run=True):
+        assert "DKTPU_PS_ENDPOINT=10.0.0.1:7171,10.0.0.2:7172" in cmd
+    # No standby: single endpoint, no standby line — PR 4 behavior intact.
+    bare = Job(Punchcard(job_name="j", script="s.py", hosts=["h"],
+                         ps={"port": 7077}))
+    assert bare.punchcard.ps_endpoint() == "h:7077"
+    assert bare.render_standby_command() is None
