@@ -88,6 +88,11 @@ from distkeras_tpu.resilience import (  # noqa: F401
     Supervisor,
     supervise,
 )
+from distkeras_tpu.fleet import (  # noqa: F401
+    ElasticTraining,
+    FleetJob,
+    FleetScheduler,
+)
 
 __all__ = [
     "Trainer",
@@ -123,6 +128,9 @@ __all__ = [
     "FaultPlan",
     "Supervisor",
     "supervise",
+    "FleetScheduler",
+    "FleetJob",
+    "ElasticTraining",
     "Model",
     "DATA_AXIS",
     "MODEL_AXIS",

@@ -1,0 +1,56 @@
+"""fleet — the multi-job elastic control plane.
+
+One worker pool, many tenants' training jobs, scheduled like a cluster
+manager (the Spark resource-manager role the reference delegated and
+never implemented):
+
+* :mod:`~distkeras_tpu.fleet.scheduler` — :class:`FleetScheduler`:
+  per-tenant quotas, priority/FIFO queueing, gang placement (a job
+  starts only when its minimum gang fits), preemption-driven elastic
+  shrink/expand mid-run via PS lease revocation with a hard shrink
+  floor at each job's min gang, graceful full-preemption drain +
+  requeue, and the ``preempt@R`` chaos drill;
+* :mod:`~distkeras_tpu.fleet.job` — :class:`FleetJob`: the placement
+  contract (tenant, priority, gang bounds) + the duck-typed runtime
+  protocol the scheduler drives;
+* :mod:`~distkeras_tpu.fleet.run` — :class:`ElasticTraining`: the real
+  training runtime — a claim-queue round schedule over a per-job netps
+  parameter server, so worker counts change mid-run without losing
+  progress or exactly-once commit semantics;
+* :mod:`~distkeras_tpu.fleet.ports` — the per-host bind-probed port
+  pool (:func:`reserve_port`) that lets two jobs' servers coexist on
+  one host (threaded through ``Punchcard.ps_endpoint``).
+
+Per-tenant telemetry attribution rides on metric names
+(``fleet.<metric>.<tenant>.<job>``) and ambient
+:func:`~distkeras_tpu.telemetry.scoped_labels`; ``python -m
+distkeras_tpu.telemetry report`` renders the per-tenant table. Docs:
+docs/FLEET.md.
+"""
+
+from __future__ import annotations
+
+from distkeras_tpu.fleet.job import (  # noqa: F401
+    DONE,
+    DRAINING,
+    FAILED,
+    QUEUED,
+    RUNNING,
+    FleetJob,
+)
+from distkeras_tpu.fleet.ports import (  # noqa: F401
+    PortPool,
+    release_port,
+    reserve_port,
+)
+from distkeras_tpu.fleet.run import ElasticTraining  # noqa: F401
+from distkeras_tpu.fleet.scheduler import (  # noqa: F401
+    FleetScheduler,
+    parse_quotas,
+)
+
+__all__ = [
+    "FleetScheduler", "FleetJob", "ElasticTraining",
+    "PortPool", "reserve_port", "release_port", "parse_quotas",
+    "QUEUED", "RUNNING", "DRAINING", "DONE", "FAILED",
+]

@@ -1,0 +1,112 @@
+"""Per-host port pool: bind-probed allocation for multi-job hosts.
+
+The reference pinned ``master_port`` (and our :class:`~distkeras_tpu.
+job_deployment.Punchcard` inherited fixed defaults: coordinator 8476, PS
+7077) — fine for one job per host, fatal for a fleet: the second job's PS
+``bind()`` dies on ``EADDRINUSE`` and its workers dial the FIRST job's
+server. This pool hands out ports that are
+
+* **probe-verified** — a candidate is bound (``SO_REUSEADDR`` off, so a
+  TIME_WAIT socket still rejects it) and released before being returned;
+* **process-unique** — reserved ports are remembered, so two Punchcards
+  resolved in the same process can never collide even before either
+  server actually binds;
+* **deterministically walked** — candidates rotate through a fixed range,
+  so retries make progress instead of re-probing the same busy port.
+
+Cross-process races (another process grabbing the port between probe and
+use) remain possible as with any probe-then-bind scheme; the netps client
+retry/backoff budget absorbs the launch failure and the caller simply
+resolves a fresh card. For same-process fleets — the scheduler's whole
+deployment model — allocation is collision-free.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+#: default allocation range: above the registered-port churn, below the
+#: common ephemeral range (32768+) so the kernel's outgoing connections
+#: don't race the pool.
+PORT_LO = 20000
+PORT_HI = 32000
+
+
+class PortPool:
+    """One host's allocator. ``reserve()`` returns a probe-verified port
+    and remembers it; ``release()`` returns it to the pool (a torn-down
+    job's ports become reusable)."""
+
+    def __init__(self, lo: int = PORT_LO, hi: int = PORT_HI):
+        if not 0 < lo < hi <= 65536:
+            raise ValueError(f"bad port range [{lo}, {hi})")
+        self._lo, self._hi = int(lo), int(hi)
+        self._next = int(lo)
+        self._reserved: set = set()
+        self._lock = threading.Lock()
+
+    def reserve(self, host: str = "127.0.0.1", tries: int = 256,
+                probe: bool = True) -> int:
+        """One free port: walk candidates, skip same-process reservations,
+        bind-probe the rest (``probe=False`` skips the probe — remote
+        hosts can't be probed from here, process-uniqueness still holds),
+        retry up to ``tries`` before raising ``OSError``."""
+        for _ in range(int(tries)):
+            with self._lock:
+                port = self._next
+                self._next = port + 1 if port + 1 < self._hi else self._lo
+                if port in self._reserved:
+                    continue
+            if probe and not _probe(host, port):
+                continue
+            with self._lock:
+                if port in self._reserved:  # lost a race to another thread
+                    continue
+                self._reserved.add(port)
+            return port
+        raise OSError(
+            f"no free port on {host} in [{self._lo}, {self._hi}) "
+            f"after {tries} probes")
+
+    def release(self, port: int) -> None:
+        with self._lock:
+            self._reserved.discard(int(port))
+
+    def reserved(self) -> set:
+        with self._lock:
+            return set(self._reserved)
+
+
+def _probe(host: str, port: int) -> bool:
+    """Can we bind ``host:port`` right now? The socket is closed again —
+    the caller's server performs the real bind."""
+    probe_host = "" if host in ("0.0.0.0", "") else host
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            s.bind((probe_host, port))
+        finally:
+            s.close()
+    except OSError:
+        return False
+    return True
+
+
+#: the process-ambient pool — every local launch path resolves through it
+#: (ports are a host resource; one pool per process keeps same-process
+#: jobs disjoint by construction).
+_POOL = PortPool()
+
+
+def reserve_port(host: str = "127.0.0.1") -> int:
+    """Reserve one port from the ambient pool. Local hosts are
+    bind-probed; a remote ``host`` gets a process-unique (unprobed)
+    reservation — still enough to keep two jobs launched from one driver
+    off the same remote port."""
+    local = host in ("127.0.0.1", "localhost", "0.0.0.0", "")
+    return _POOL.reserve("127.0.0.1" if local else host, probe=local)
+
+
+def release_port(port: int) -> None:
+    _POOL.release(port)

@@ -1,0 +1,340 @@
+"""Elastic training runtime: one fleet job's workers over its own netps PS.
+
+:class:`ElasticTraining` adapts the repo's training pieces (a built
+:class:`~distkeras_tpu.models.Model`, an optax ``tx``, a loss, a
+:class:`~distkeras_tpu.data.batching.BatchPlan`) to the scheduler's
+runtime protocol (:mod:`distkeras_tpu.fleet.job`). Where
+:func:`~distkeras_tpu.netps.remote.run_remote` runs a *fixed* W threads
+for exactly ``plan.num_rounds`` rounds, this runtime must survive the
+scheduler changing its worker count mid-run, so the schedule is a
+**claim queue** of ``num_rounds x num_workers`` work items — one
+``(round, data slice)`` pair per planned worker-window, claimed in
+round-major order. The WORK SET is therefore exactly the plan's (every
+slice of every round trains once, whatever the worker count did
+mid-run — ``num_epoch`` means what it says), and it is deterministic:
+the window computed for item ``(r, s)`` depends only on the plan and
+the seed, never on which slot claimed it; only the fold *order* varies,
+as it does for any async PS. An item whose commit was lost to
+preemption/eviction (the discarded-window path) is returned to the
+queue for whichever worker claims it next. The job is done when every
+item has been *committed* — shrink just means fewer concurrent
+claimants, and the PS counter rule charges whatever staleness the churn
+realized.
+
+The parameter server is per-job (each tenant trains its own center):
+in-process by default, or an external ``endpoint=`` (e.g. a
+``python -m distkeras_tpu.netps`` subprocess with a state dir, so the
+fleet chaos smoke can SIGKILL it mid-run). Progress lives on the PS, so
+a fully-preempted job resumes exactly where it stopped when the
+scheduler re-grants its gang — the workers rejoin with their commit
+sequences intact.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from distkeras_tpu.data.batching import BatchPlan
+from distkeras_tpu.netps.client import PSClient
+from distkeras_tpu.netps.fold import check_discipline
+from distkeras_tpu.resilience import faults as _faults
+
+
+class ElasticTraining:
+    """One job's training work, elastically workered. See module docstring.
+
+    ``plan`` is laid out for ``plan.num_workers`` = the job's
+    ``max_workers`` (worker ``w`` always computes on its own data slice
+    ``plan.index[r, w]``, however many peers are active). ``endpoint=None``
+    launches an in-process :class:`~distkeras_tpu.netps.server.PSServer`
+    on ``ensure_started``.
+    """
+
+    def __init__(self, *, model, tx, loss_fn, plan: BatchPlan,
+                 discipline: str = "adag", alpha: float = 0.05,
+                 seed: int = 0, compute_dtype=None, grad_accum: int = 1,
+                 endpoint: Optional[str] = None,
+                 server=None,
+                 lease_s: Optional[float] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 timeout: Optional[float] = None,
+                 retries: Optional[int] = None,
+                 backoff: Optional[float] = None):
+        self.model = model
+        self.tx = tx
+        self.loss_fn = loss_fn
+        self.plan = plan
+        self.discipline = check_discipline(discipline)
+        self.alpha = float(alpha)
+        self.seed = int(seed)
+        self.compute_dtype = compute_dtype
+        self.grad_accum = int(grad_accum)
+        self._endpoint = endpoint
+        self._lease_s = lease_s
+        self._host, self._port = host, int(port)
+        self._client_kw = dict(timeout=timeout, retries=retries,
+                               backoff=backoff)
+        #: the in-process PS (None when endpoint= is external). A caller-
+        #: built ``server=`` is adopted — revocation lands on it even when
+        #: the data path runs through something else (a chaos proxy) — and
+        #: closed by :meth:`close` like an owned one.
+        self.server = server
+        if server is not None and endpoint is None:
+            self._endpoint = server.endpoint
+        #: one loss cell per planned worker-window, like run_remote's.
+        self.losses = np.full((plan.num_rounds, plan.num_workers), np.nan,
+                              np.float32)
+        self.errors: list = []
+        self._lock = threading.Lock()
+        #: work items are (round, slice) pairs flattened round-major:
+        #: item i = (i // W, i % W) — the plan's full schedule.
+        self._total_items = plan.num_rounds * plan.num_workers
+        self._next_item = 0
+        self._retry: collections.deque = collections.deque()
+        self._committed = 0
+        self._applied = 0
+        self._stale = collections.deque(maxlen=256)
+        self._started = False
+        self._closed = False
+        self._loop_fn = None
+        self._treedef = None
+        self._init_leaves = None
+        self._final_params = None
+
+    # -- runtime protocol --------------------------------------------------
+    def ensure_started(self) -> None:
+        """Idempotent: compile the jitted window loop and (first call
+        only) launch the in-process PS. A re-placement after a full
+        preemption lands here again and finds everything warm."""
+        if self._started:
+            return
+        import jax
+
+        from distkeras_tpu.workers import make_local_loop
+
+        self._treedef = jax.tree.structure(self.model.params)
+        self._init_leaves = [np.asarray(a, np.float32)
+                             for a in jax.tree.leaves(self.model.params)]
+        self._loop_fn = jax.jit(make_local_loop(
+            self.model.module, self.loss_fn, self.tx,
+            compute_dtype=self.compute_dtype,
+            state_collections=self.model.state_collections,
+            grad_accum=self.grad_accum,
+            normalize_uint8=getattr(self.model, "normalize_uint8", True)))
+        if self._endpoint is None:
+            from distkeras_tpu.netps.server import PSServer
+
+            self.server = PSServer(
+                discipline=self.discipline, host=self._host,
+                port=self._port, lease_s=self._lease_s).start()
+            self._endpoint = self.server.endpoint
+        self._started = True
+
+    @property
+    def endpoint(self) -> Optional[str]:
+        return self._endpoint
+
+    @property
+    def worker_slots(self) -> int:
+        """Highest worker id + 1 this runtime's data layout supports
+        (``plan.index[r, w]`` is laid out for exactly this many workers).
+        The scheduler validates a job's ``max_workers`` against it at
+        submit — an expansion past the layout would IndexError the worker
+        and burn the restart budget on a healthy job."""
+        return self.plan.num_workers
+
+    def progress(self) -> int:
+        """Cumulative applied commits (the ``preempt@R`` clock)."""
+        return self._applied
+
+    def done(self) -> bool:
+        with self._lock:
+            return self._committed >= self._total_items
+
+    def revoke(self, worker_id: int) -> None:
+        """Lease revocation — the preemption primitive. In-process
+        servers revoke directly; against an external PS the released
+        worker simply goes silent and the server's own lease monitor
+        evicts it (same observable churn, one lease later)."""
+        if self.server is not None:
+            self.server.revoke(worker_id)
+
+    def close(self) -> None:
+        """Finalize: pull the final center into the model, then drain and
+        close the in-process PS. Idempotent; safe on a never-started or
+        failed job."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._endpoint is not None and self._committed > 0:
+            try:
+                with PSClient(self._endpoint, **self._client_kw) as obs:
+                    leaves, _updates = obs.pull()
+                self._final_params = self._unflatten(leaves)
+            except Exception as e:  # noqa: BLE001 - surfaced via errors
+                self.errors.append(e)
+        if self.server is not None:
+            self.server.close()
+
+    def result(self):
+        """The trained model (final center) after :meth:`close`; the
+        as-built model when nothing was ever committed."""
+        if self._final_params is None:
+            return self.model
+        return self.model.with_params(self._final_params)
+
+    # -- the worker loop ---------------------------------------------------
+    def _unflatten(self, leaves):
+        import jax
+
+        return jax.tree.unflatten(self._treedef,
+                                  [np.asarray(a) for a in leaves])
+
+    def _claim(self, should_run) -> Optional[int]:
+        """The next work item to process: the retry queue first, then the
+        frontier. Blocks (politely) while other workers' claims are still
+        in flight — exiting early would strand a requeued item."""
+        while should_run():
+            with self._lock:
+                if self._retry:
+                    return self._retry.popleft()
+                if self._next_item < self._total_items:
+                    i = self._next_item
+                    self._next_item += 1
+                    return i
+                if self._committed >= self._total_items:
+                    return None
+            time.sleep(0.01)
+        return None
+
+    def _requeue(self, item: int) -> None:
+        with self._lock:
+            self._retry.append(item)
+
+    def _commit_done(self, r: int, s: int, loss: float,
+                     staleness: int) -> None:
+        from distkeras_tpu import telemetry
+
+        suffix = telemetry.label_suffix()
+        with self._lock:
+            self._committed += 1
+            self._applied += 1
+            self.losses[r, s] = loss
+            if staleness >= 0:
+                self._stale.append(int(staleness))
+            vals = list(self._stale)
+        telemetry.counter(f"fleet.commits{suffix}").add(1)
+        if vals:
+            telemetry.gauge(f"fleet.staleness_mean{suffix}").set(
+                round(float(np.mean(vals)), 3))
+            telemetry.gauge(f"fleet.staleness_max{suffix}").set(
+                float(max(vals)))
+
+    def worker_main(self, worker_id: int, should_run) -> None:
+        """One granted slot's loop: join -> (claim round; pull; K local
+        steps; commit) until released or all rounds committed. The body
+        is :func:`~distkeras_tpu.netps.remote.run_remote`'s serial path
+        re-based on the claim queue; eviction/rejoin/readopt semantics
+        are identical."""
+        import jax
+
+        from distkeras_tpu import telemetry
+        from distkeras_tpu.netps.remote import _worker_round
+
+        w = int(worker_id)
+        suffix = telemetry.label_suffix()
+        elastic = self.discipline in ("aeasgd", "eamsgd")
+        client = PSClient(self._endpoint, worker_id=w, **self._client_kw)
+        try:
+            center_leaves, counter = client.join(init=self._init_leaves)
+            params = self._unflatten(center_leaves)
+            opt_state = self.tx.init(params)
+            local = params if elastic else None
+            mstate = (jax.tree.map(np.asarray, self.model.state)
+                      if self.model.state is not None else None)
+            base_key = jax.random.key(self.seed)
+            rejoins_seen = client.rejoin_count
+            readopt = False
+            while True:
+                item = self._claim(should_run)
+                if item is None:
+                    break
+                r, s = divmod(item, self.plan.num_workers)
+                committed = False
+                try:
+                    with telemetry.span(f"fleet.round{suffix}"):
+                        net = _faults.active_net_plan()
+                        if net is not None and s == 0:
+                            # Under the claim queue, round R's slice-0
+                            # item belongs to exactly one worker — so
+                            # `evict@R` kills WHOEVER claimed it
+                            # (run_remote's seeded per-worker pick would
+                            # almost never match a claimant here).
+                            arg = net.fire("evict", r)
+                            if arg is not None:
+                                # Go silent past the lease (the worker-kill
+                                # drill); the next RPC rejoins.
+                                lease = client.lease_s or 1.0
+                                time.sleep(arg if arg > 0 else 2.0 * lease)
+                        pulled_leaves, counter = client.pull()
+                        if client.rejoin_count > rejoins_seen or readopt:
+                            rejoins_seen = client.rejoin_count
+                            readopt = False
+                            if elastic:
+                                local = self._unflatten(pulled_leaves)
+                                opt_state = self.tx.init(local)
+                        start = (local if elastic
+                                 else self._unflatten(pulled_leaves))
+                        # The DATA slice and rng come from the claimed
+                        # item (s), not the claiming slot (w): the work
+                        # set is the plan's, deterministically, whatever
+                        # the elastic worker count did mid-run.
+                        xs, ys = _worker_round(self.plan, r, s)
+                        rng = jax.random.fold_in(
+                            jax.random.fold_in(base_key, s), r)
+                        new_params, opt_state, mstate, window_losses = \
+                            self._loop_fn(start, opt_state, xs, ys, rng,
+                                          mstate)
+                        new_leaves = [np.asarray(a, np.float32)
+                                      for a in jax.tree.leaves(new_params)]
+                        pulled_np = [np.asarray(a, np.float32)
+                                     for a in pulled_leaves]
+                        if elastic:
+                            e = [self.alpha * (n - p)
+                                 for n, p in zip(new_leaves, pulled_np)]
+                            local = self._unflatten(
+                                [n - d for n, d in zip(new_leaves, e)])
+                            delta = e
+                        else:
+                            delta = [n - p
+                                     for n, p in zip(new_leaves, pulled_np)]
+                            if self.discipline == "adag":
+                                delta = [d / float(self.plan.window)
+                                         for d in delta]
+                        res = client.commit(delta, counter)
+                        if res.evicted:
+                            # Preempted or lease-lapsed with this window in
+                            # flight: the commit was discarded; the client
+                            # already rejoined. Requeue the round and start
+                            # over from a fresh pull.
+                            readopt = True
+                        elif res.applied or res.duplicate:
+                            committed = True
+                            self._commit_done(
+                                r, s,
+                                float(np.mean(np.asarray(window_losses))),
+                                res.staleness)
+                finally:
+                    if not committed:
+                        self._requeue(item)
+            client.leave()
+        except BaseException as e:  # noqa: BLE001 - surfaced to the reaper
+            self.errors.append(e)
+            raise
+        finally:
+            client.close()

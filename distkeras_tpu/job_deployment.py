@@ -37,11 +37,18 @@ class Punchcard:
     worker the endpoint via ``DKTPU_PS_ENDPOINT``, so trainers constructed
     without an explicit ``remote=`` pick it up automatically.
 
+    Ports: a missing ``port`` (and ``coordinator_port``, and
+    ``standby_port``) is allocated from the per-host bind-probed pool
+    (``distkeras_tpu/fleet/ports``) and pinned into the card on first
+    resolution — two punchcards launched from one driver can never
+    collide on a host, which fixed defaults (8476/7077/primary+1) could
+    not guarantee. Explicit ports are always honored untouched.
+
     Durability/failover keys (all optional): ``state_dir`` gives the
     primary a durable journal+snapshot directory (``--state-dir``) so
     :meth:`Job.supervise` can cold-restart a dead PS with its center,
     counter, and dedup state intact; ``standby_host``/``standby_port``
-    (port defaults to primary port + 1) additionally launch a warm
+    (port pool-allocated when unset) additionally launch a warm
     standby (``--standby``) that tails the primary's journal and promotes
     when its lease lapses — the workers' ``DKTPU_PS_ENDPOINT`` then
     carries the comma-separated ``primary,standby`` list their hardened
@@ -51,30 +58,89 @@ class Punchcard:
     job_name: str
     script: str
     hosts: Sequence[str]
-    coordinator_port: int = 8476
+    #: None = allocate from the per-host port pool on first render (two
+    #: punchcards launched from one driver can then never collide on the
+    #: coordinator port); pass an int to pin it (reference parity: 8476).
+    coordinator_port: Optional[int] = None
     env: dict = dataclasses.field(default_factory=dict)
     args: Sequence[str] = ()
     ps: Optional[dict] = None
+    #: tenant this job bills to — stamped on every supervision telemetry
+    #: event (restarts, straggler kills, PS revivals) so the report CLI
+    #: can attribute churn per tenant in a multi-job fleet.
+    tenant: Optional[str] = None
+
+    def _reserve(self, host: str) -> int:
+        """Pool-allocate one port and remember it for
+        :meth:`release_ports` (explicit ports are never tracked — only
+        what this card took from the pool is returned to it)."""
+        from distkeras_tpu.fleet.ports import reserve_port
+
+        port = reserve_port(host)
+        # Not a dataclass field on purpose: to_json()/asdict must not
+        # carry it, and from_json round-trips without it.
+        self.__dict__.setdefault("_allocated_ports", []).append(port)
+        return port
+
+    def release_ports(self) -> None:
+        """Return every pool-allocated port to the per-host pool AND
+        clear its pin from the card — a relaunch of the same card must
+        re-reserve, not render endpoints on ports the pool already
+        considers free. Called by :class:`Job` teardown (kill / wait /
+        clean supervise exit) so a long-lived driver launching many jobs
+        cannot exhaust the pool; idempotent, and a no-op for cards with
+        explicit ports (those are never tracked, never cleared)."""
+        from distkeras_tpu.fleet.ports import release_port
+
+        allocated = set(self.__dict__.pop("_allocated_ports", []))
+        for port in allocated:
+            release_port(port)
+        if self.coordinator_port in allocated:
+            self.coordinator_port = None
+        if self.ps:
+            if self.ps.get("port") in allocated:
+                del self.ps["port"]
+            if self.ps.get("standby_port") in allocated:
+                del self.ps["standby_port"]
+
+    def resolved_coordinator_port(self) -> int:
+        """The coordinator port, allocating (and pinning) one from the
+        bind-probed per-host pool when none was given — the allocation is
+        sticky, so every later render agrees with the first."""
+        if not self.coordinator_port:
+            self.coordinator_port = self._reserve(self.hosts[0])
+        return int(self.coordinator_port)
 
     def ps_endpoint(self) -> Optional[str]:
         """Endpoint(s) of the parameter server, None when ``ps`` unset:
         ``host:port``, or the ``primary,standby`` failover list when a
-        standby is configured (the order the clients walk)."""
+        standby is configured (the order the clients walk). A missing
+        ``port`` is allocated from the per-host pool (bind-probed, sticky
+        — stored back into ``ps`` so the launch command, the workers'
+        ``DKTPU_PS_ENDPOINT``, and every later call agree); the old fixed
+        7077 default broke the second job on a host."""
         if self.ps is None:
             return None
         host = self.ps.get("host") or self.hosts[0]
-        port = int(self.ps.get("port", 7077))
-        primary = f"{host}:{port}"
+        port = self.ps.get("port")
+        if not port:
+            port = self.ps["port"] = self._reserve(host)
+        primary = f"{host}:{int(port)}"
         standby = self.ps_standby_endpoint()
         return f"{primary},{standby}" if standby else primary
 
     def ps_standby_endpoint(self) -> Optional[str]:
-        """``host:port`` of the warm standby, None when not configured."""
+        """``host:port`` of the warm standby, None when not configured.
+        Like the primary's, a missing ``standby_port`` is pool-allocated
+        and pinned (the old ``primary + 1`` rule collided as soon as a
+        second job's primary landed on that port)."""
         if self.ps is None or not self.ps.get("standby_host"):
             return None
-        port = int(self.ps.get("standby_port",
-                               int(self.ps.get("port", 7077)) + 1))
-        return f"{self.ps['standby_host']}:{port}"
+        port = self.ps.get("standby_port")
+        if not port:
+            port = self.ps["standby_port"] = self._reserve(
+                self.ps["standby_host"])
+        return f"{self.ps['standby_host']}:{int(port)}"
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)
@@ -117,7 +183,7 @@ class Job:
         """One command line per host, with the jax.distributed bootstrap env
         (plus ``DKTPU_PS_ENDPOINT`` when the punchcard carries a ``ps``)."""
         pc = self.punchcard
-        coordinator = f"{pc.hosts[0]}:{pc.coordinator_port}"
+        coordinator = f"{pc.hosts[0]}:{pc.resolved_coordinator_port()}"
         endpoint = pc.ps_endpoint()
         cmds = []
         for i, _host in enumerate(pc.hosts):
@@ -138,7 +204,10 @@ class Job:
         pc = self.punchcard
         if pc.ps is None:
             return None
-        port = int(pc.ps.get("port", 7077))
+        # ps_endpoint() pins a pool-allocated port into ps["port"] when
+        # none was given, so the launch line and the workers' env agree.
+        pc.ps_endpoint()
+        port = int(pc.ps["port"])
         cmd = (f"python -m distkeras_tpu.netps --host 0.0.0.0 "
                f"--port {port} "
                f"--discipline {shlex.quote(pc.ps.get('discipline', 'adag'))}")
@@ -172,6 +241,15 @@ class Job:
         if pc.ps.get("snapshot_every") is not None:
             cmd += f" --snapshot-every {int(pc.ps['snapshot_every'])}"
         return cmd
+
+    def _labels(self) -> dict:
+        """Attribution fields for supervision telemetry events: the
+        punchcard's job name plus, when set, the tenant it bills to — the
+        report CLI groups restart/straggler/PS-revival churn by these."""
+        labels = {"job": self.punchcard.job_name}
+        if self.punchcard.tenant:
+            labels["tenant"] = self.punchcard.tenant
+        return labels
 
     def _spawn(self, i: int) -> subprocess.Popen:
         """(Re)launch host ``i``'s command."""
@@ -234,6 +312,7 @@ class Job:
             self.kill()
             raise
         self._stop_ps()
+        self.punchcard.release_ports()
         return rcs
 
     def _stop_ps(self, grace: float = 5.0) -> None:
@@ -291,6 +370,7 @@ class Job:
                 # outlives the job holding its port (kill() covers every
                 # teardown path; this is the one return that skips kill).
                 self._stop_ps()
+                self.punchcard.release_ports()
                 return rcs
             for i in failed:
                 # Full jitter (same rule as the netps client's RPC retries):
@@ -301,6 +381,7 @@ class Job:
                 self.restarts[i] += 1
                 telemetry.counter("resilience.host_restarts").add(1)
                 telemetry.event("host_restart", {
+                    **self._labels(),
                     "host": self.punchcard.hosts[i], "index": i,
                     "exit_code": rcs[i], "restart": self.restarts[i]})
                 time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
@@ -316,6 +397,7 @@ class Job:
                     telemetry.counter("resilience.straggler_kills").add(
                         len(stragglers))
                     telemetry.event("straggler_kill", {
+                        **self._labels(),
                         "hosts": [self.punchcard.hosts[i]
                                   for i in stragglers]})
                     self.kill()
@@ -357,6 +439,7 @@ class Job:
             self.ps_restarts += 1
             telemetry.counter("resilience.ps_restarts").add(1)
             telemetry.event("ps_restart", {
+                **self._labels(),
                 "role": role, "exit_code": p.returncode,
                 "restart": self.ps_restarts})
             setattr(self, attr, self._spawn_cmd(host, cmd_fn()))
@@ -393,3 +476,6 @@ class Job:
                     p.wait(timeout=grace)
                 except subprocess.TimeoutExpired:
                     pass  # unreapable: do not hang the caller's teardown
+        # Every process is down (or abandoned): the card's pool-allocated
+        # ports go back to the per-host pool for the next job.
+        self.punchcard.release_ports()

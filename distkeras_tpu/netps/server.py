@@ -345,6 +345,29 @@ class PSServer:
                 telemetry.counter("netps.evictions").add(1)
                 telemetry.event("netps_eviction", {"worker": w})
 
+    def revoke(self, worker_id: int) -> bool:
+        """Administrative lease revocation — the fleet scheduler's
+        preemption primitive. The worker is evicted NOW (not at its lease
+        deadline): membership dropped, half-assembled commit stripes
+        purged, its next RPC answers ``lease_expired``. Dedup state
+        (``_last_seq``) survives exactly as with a natural eviction, so a
+        revoked worker's in-flight retransmit is still deduped and a
+        later re-grant rejoins with its sequence intact. Returns whether
+        the worker was a member."""
+        from distkeras_tpu import telemetry
+
+        wid = int(worker_id)
+        with self._lock:
+            present = wid in self._members
+            if present:
+                del self._members[wid]
+                self.evictions += 1
+                self._purge_pending(wid)
+        if present:
+            telemetry.counter("netps.revocations").add(1)
+            telemetry.event("netps_revocation", {"worker": wid})
+        return present
+
     # ------------------------------------------------------------------
     def _handle(self, conn: socket.socket) -> None:
         """One connection's handler thread — the reference's

@@ -75,12 +75,17 @@ _KINDS = frozenset({
 #: lease renewal queues behind a genuinely hung PS (what ``Job.supervise``
 #: must tell apart from a draining one). Both are consumed by the server
 #: process, never by the proxy — schedule them only in the PS process's
-#: environment.
+#: environment. ``preempt@R[:N]`` is the control-plane drill: when the
+#: fleet's cumulative commit count crosses R, the ``FleetScheduler``
+#: forcibly preempts N workers (default 1) from its lowest-priority
+#: running job exactly as a capacity squeeze would — lease revocation,
+#: shrink floor at the victim's min gang, full drain + requeue when the
+#: floor is already reached (``distkeras_tpu/fleet/scheduler.py``).
 _NET_KINDS = frozenset({
     "delay", "drop", "dup", "truncate", "partition", "evict",
     "delay_r", "drop_r", "dup_r", "truncate_r",
     "shm_delay", "shm_corrupt",
-    "ps_crash", "ps_hang",
+    "ps_crash", "ps_hang", "preempt",
 })
 
 

@@ -92,8 +92,15 @@ class Supervisor:
                             "resilience.supervisor_exhausted").add(1)
                         raise
                     telemetry.counter("resilience.supervisor_retries").add(1)
+                    # The event records HOW the retry recovers (resume vs
+                    # scratch) and — fired under any ambient
+                    # ``telemetry.scoped_labels`` scope, e.g. a fleet
+                    # worker's — carries the tenant/job attribution
+                    # automatically, so a multi-tenant report can separate
+                    # whose training is churning.
                     telemetry.event("supervisor_retry", {
-                        "attempt": self.attempts, "error": repr(e)})
+                        "attempt": self.attempts, "error": repr(e),
+                        "resume": bool(self.trainer.checkpoint_dir)})
                     how = ("resuming from checkpoint"
                            if self.trainer.checkpoint_dir
                            else "restarting from scratch")

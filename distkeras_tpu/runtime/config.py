@@ -73,7 +73,8 @@ class EnvVar:
     kind: str
     default: object
     doc: str
-    category: str  # "observability" | "resilience" | "network" | "data" | "interop"
+    # "observability" | "resilience" | "network" | "fleet" | "data" | "interop"
+    category: str
 
 
 def _declare(*vars_: EnvVar) -> dict:
@@ -177,11 +178,14 @@ ENV_REGISTRY: dict = _declare(
            "pull counter = min of constituents).",
            "network"),
     EnvVar("DKTPU_NET_FAULTS", "str", "",
-           "Network-fault chaos plan for the netps proxy, shm ring, and "
-           "remote worker loop: `kind@frame[:arg]` entries (`delay`/`drop`/"
+           "Network-fault chaos plan for the netps proxy, shm ring, "
+           "remote worker loop, PS server, and fleet scheduler: "
+           "`kind@frame[:arg]` entries (`delay`/`drop`/"
            "`dup`/`truncate`/`partition`/`evict`, `_r` suffix = reply "
            "direction; `shm_delay`/`shm_corrupt` hit the shared-memory "
-           "ring) separated by `;`, e.g. `delay@3:0.2;drop@5;partition@7:2`. "
+           "ring; `ps_crash`/`ps_hang` hit the server process; `preempt` "
+           "drives the FleetScheduler's forced-preemption drill) "
+           "separated by `;`, e.g. `delay@3:0.2;drop@5;partition@7:2`. "
            "Empty = no injection. See docs/RESILIENCE.md.",
            "network"),
     EnvVar("DKTPU_PS_LEASE", "float", 10.0,
@@ -216,6 +220,32 @@ ENV_REGISTRY: dict = _declare(
            "promotes itself when the primary's lease lapses, and fences "
            "the old epoch. Empty = run as a primary.",
            "network"),
+    EnvVar("DKTPU_FLEET_CAPACITY", "int", 0,
+           "Worker-slot capacity of a FleetScheduler constructed without an "
+           "explicit `capacity=`; 0 = no default (the constructor then "
+           "requires one).",
+           "fleet"),
+    EnvVar("DKTPU_FLEET_TICK", "float", 0.05,
+           "Seconds between FleetScheduler passes in `run()`/`start()` "
+           "(reap finished workers, fire preempt faults, place queued "
+           "jobs, expand elastically).",
+           "fleet"),
+    EnvVar("DKTPU_FLEET_PREEMPT_GRACE", "float", 0.0,
+           "Seconds a preempted worker gets to exit at a round boundary "
+           "before the scheduler revokes its lease on the job's parameter "
+           "server; 0 = revoke immediately (the worker's in-flight window "
+           "is discarded by the eviction path, never double-folded).",
+           "fleet"),
+    EnvVar("DKTPU_FLEET_QUOTA", "str", "",
+           "Per-tenant worker-slot quotas for a FleetScheduler constructed "
+           "without explicit `quotas=`: `tenant=N` entries separated by "
+           "`;` (e.g. `acme=4;bidco=2`). Empty = every tenant may use the "
+           "whole pool.",
+           "fleet"),
+    EnvVar("DKTPU_FLEET_MAX_RESTARTS", "int", 3,
+           "Per-job budget of crashed-worker restarts the FleetScheduler "
+           "performs before declaring the job failed and draining it.",
+           "fleet"),
     EnvVar("DKTPU_NO_NATIVE", "bool", False,
            "`1` disables the native (C++) data-plane kernels; every gather "
            "falls back to numpy (bit-identical, slower).",

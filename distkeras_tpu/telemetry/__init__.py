@@ -28,9 +28,13 @@ from distkeras_tpu.telemetry.core import (
     Gauge,
     Histogram,
     Telemetry,
+    current_labels,
     enabled,
     get,
+    label_suffix,
     reset,
+    sanitize_label,
+    scoped_labels,
 )
 from distkeras_tpu.telemetry.exporters import (
     parse_prometheus,
@@ -49,6 +53,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "Telemetry",
     "enabled", "get", "reset",
     "span", "counter", "gauge", "histogram", "event",
+    "scoped_labels", "current_labels", "label_suffix", "sanitize_label",
     "write_jsonl", "read_jsonl", "prometheus_text", "parse_prometheus",
     "DisciplineMonitor", "flag_stragglers", "staleness_schedule",
     "dynsgd_scales",

@@ -19,6 +19,7 @@ Design constraints, in order:
 from __future__ import annotations
 
 import bisect
+import re
 import threading
 import time
 from typing import Optional
@@ -166,6 +167,80 @@ class Histogram:
             return snap
 
 
+# -- attribution labels (per-thread, ambient) -------------------------------
+#: thread-local stack of label dicts pushed by :func:`scoped_labels`.
+_LABELS = threading.local()
+
+#: metric-name suffix order: the tenant owns the job, so the tenant comes
+#: first — `fleet.commits.<tenant>.<job>` groups by tenant in sorted dumps.
+_LABEL_ORDER = ("tenant", "job")
+
+#: label values ride inside dotted metric names, so they must stay single
+#: dot-free tokens; anything else is flattened to `-`.
+_LABEL_SANITIZE = re.compile(r"[^0-9A-Za-z_-]+")
+
+
+def sanitize_label(value) -> str:
+    """One metric-name-safe token for a label value (dots and whitespace
+    become ``-``; empty values read ``unknown``)."""
+    return _LABEL_SANITIZE.sub("-", str(value)).strip("-") or "unknown"
+
+
+def current_labels() -> dict:
+    """The merged ambient label dict for this thread (innermost scope
+    wins), ``{}`` when no scope is active."""
+    stack = getattr(_LABELS, "stack", None)
+    if not stack:
+        return {}
+    merged: dict = {}
+    for d in stack:
+        merged.update(d)
+    return merged
+
+
+def label_suffix() -> str:
+    """The ambient labels as a metric-name suffix: ``.<tenant>.<job>``
+    (sanitized, tenant first), ``""`` when no scope is active — so
+    instrumented code can write ``counter("fleet.commits" +
+    label_suffix())`` and stay label-free outside a fleet run."""
+    labels = current_labels()
+    parts = [sanitize_label(labels[k]) for k in _LABEL_ORDER if k in labels]
+    return ("." + ".".join(parts)) if parts else ""
+
+
+class _LabelScope:
+    """Context manager pushing one label dict onto the thread's stack.
+    Events recorded inside the scope carry the labels automatically
+    (:meth:`Telemetry.event` merges them under any explicit fields)."""
+
+    __slots__ = ("_labels",)
+
+    def __init__(self, labels: dict):
+        self._labels = labels
+
+    def __enter__(self) -> "_LabelScope":
+        stack = getattr(_LABELS, "stack", None)
+        if stack is None:
+            stack = _LABELS.stack = []
+        stack.append(self._labels)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        stack = getattr(_LABELS, "stack", None)
+        if stack and stack[-1] is self._labels:
+            stack.pop()
+        return None
+
+
+def scoped_labels(**labels) -> _LabelScope:
+    """Attach attribution labels (``tenant=``, ``job=``, ...) to this
+    thread for the scope's duration. The fleet scheduler wraps every
+    worker thread in one, so per-job metrics and every event fired under
+    it (supervisor retries, host restarts, evictions) are attributable
+    to a tenant without threading arguments through each call site."""
+    return _LabelScope(dict(labels))
+
+
 class _SpanContext:
     """Context manager recording one timed span into the registry.
 
@@ -302,6 +377,10 @@ class Telemetry:
         if not self.enabled:
             return
         rec = {"kind": kind, "ts": time.time()}
+        # Ambient attribution labels ride under the explicit fields: an
+        # event fired inside a fleet worker scope names its tenant/job
+        # without the call site knowing the scope exists.
+        rec.update(current_labels())
         if fields:
             rec.update(fields)
         with self._lock:

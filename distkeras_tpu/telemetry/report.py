@@ -125,6 +125,52 @@ def staleness_summary(rounds: list[dict]) -> Optional[dict]:
     return out or None
 
 
+#: metric names recognized inside ``fleet.<metric>.<tenant>.<job>``
+#: counter/gauge/span names (the fleet scheduler's attribution
+#: convention — tenant and job are sanitized to dot-free tokens, so a
+#: 4-way split is unambiguous).
+_FLEET_METRICS = frozenset({
+    "commits", "preemptions", "shrinks", "expands", "restarts",
+    "placements", "preempt_debt", "granted", "staleness_mean",
+    "staleness_max", "round",
+})
+
+
+def fleet_attribution(summary: dict) -> list[dict]:
+    """Per-(tenant, job) rollup of the fleet scheduler's labeled metrics:
+    throughput (commits + round-span wall time), staleness, restarts, and
+    preemption accounting — one row per job, tenants grouped."""
+    jobs: dict = {}
+
+    def row(tenant: str, job: str) -> dict:
+        return jobs.setdefault((tenant, job), {"tenant": tenant, "job": job})
+
+    for name, v in summary.get("counters", {}).items():
+        parts = name.split(".")
+        if (len(parts) == 4 and parts[0] == "fleet"
+                and parts[1] in _FLEET_METRICS):
+            row(parts[2], parts[3])[parts[1]] = v
+    for name, g in summary.get("gauges", {}).items():
+        parts = name.split(".")
+        if (len(parts) == 4 and parts[0] == "fleet"
+                and parts[1] in _FLEET_METRICS):
+            row(parts[2], parts[3])[parts[1]] = g.get("value")
+    for name, h in summary.get("spans", {}).items():
+        parts = name.split(".")
+        if (len(parts) == 4 and parts[0] == "fleet" and parts[1] == "round"):
+            r = row(parts[2], parts[3])
+            r["round_total_s"] = h.get("total", 0.0)
+            r["round_mean_s"] = h.get("mean", 0.0)
+            total = h.get("total", 0.0)
+            if total > 0:
+                # Throughput = COMMITTED rounds over round wall time; the
+                # span count would also bill evicted/requeued attempts,
+                # overstating c/s exactly when preemption churn occurs.
+                commits = r.get("commits", h.get("count", 0))
+                r["commits_per_sec"] = round(commits / total, 3)
+    return [jobs[k] for k in sorted(jobs)]
+
+
 def straggler_table(rounds: list[dict], k: float = STRAGGLER_K) -> list[dict]:
     """Rounds whose wall time exceeds ``k`` x the median round time (plus
     any rounds the live monitor already flagged). Burst-tail rounds
@@ -172,6 +218,7 @@ def build_report(path: str, k: float = STRAGGLER_K) -> dict:
         "segments": segments,
         "staleness": staleness_summary(rounds),
         "stragglers": straggler_table(rounds, k),
+        "fleet": fleet_attribution(merged),
         "losses": [r["loss"] for r in rounds if "loss" in r],
     }
 
@@ -234,6 +281,22 @@ def render_report(report: dict) -> str:
             w(f"loss divergence rms:       {st['loss_divergence_rms']}\n")
             w(f"loss divergence max |.|:   "
               f"{st['loss_divergence_max_abs']}\n")
+
+    if report.get("fleet"):
+        w("\n## Fleet (per-tenant attribution)\n")
+        w(f"{'tenant':<12} {'job':<14} {'commits':>8} {'c/s':>7} "
+          f"{'stale':>6} {'preempt':>8} {'shrink':>7} {'expand':>7} "
+          f"{'restart':>8} {'debt':>5}\n")
+        for r in report["fleet"]:
+            cps = r.get("commits_per_sec")
+            w(f"{r['tenant']:<12} {r['job']:<14} "
+              f"{r.get('commits', 0):>8.0f} "
+              f"{(f'{cps:.1f}' if cps is not None else '-'):>7} "
+              f"{r.get('staleness_mean', 0.0):>6.2f} "
+              f"{r.get('preemptions', 0):>8.0f} "
+              f"{r.get('shrinks', 0):>7.0f} {r.get('expands', 0):>7.0f} "
+              f"{r.get('restarts', 0):>8.0f} "
+              f"{r.get('preempt_debt', 0.0):>5.0f}\n")
 
     w("\n## Stragglers\n")
     if report["stragglers"]:

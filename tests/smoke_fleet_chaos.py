@@ -1,0 +1,300 @@
+"""CI fleet-chaos smoke (not a pytest module — run directly).
+
+Three tenants' training jobs on ONE worker pool, driven by the
+:class:`~distkeras_tpu.fleet.FleetScheduler`, each surviving a different
+leg of the chaos matrix — the ROADMAP's "heavy traffic = many tenants,
+not one run" story, exercised end to end on every PR:
+
+* ``acme/alpha``  (prio 0): in-process PS; loses a worker to the
+  ``evict`` drill (sleeps past its lease, rejoins mid-run) and a slot or
+  two to preemption when the high-priority tenant arrives.
+* ``bidco/beta``  (prio 0): its PS sits behind a :class:`ChaosProxy`
+  injecting a partition; also a preemption victim.
+* ``corp/gamma``  (prio 5, submitted mid-run): its arrival forces the
+  scheduler to SHRINK the other tenants (lease revocation, floor at each
+  victim's min gang); its PS is a real ``python -m distkeras_tpu.netps``
+  subprocess with a state dir whose own fault plan SIGKILLs it mid-run
+  (``ps_crash``) — a babysitter thread cold-restarts it and the workers'
+  retransmits dedup exactly-once.
+
+On top, the ambient plan schedules a ``preempt@R:N`` forced-preemption
+drill against the scheduler itself. All three jobs must converge; the
+victims must re-expand once capacity frees; exactly-once is asserted on
+the in-process commit logs AND the subprocess's on-disk journal; the
+shrink floor is never violated; and the telemetry report must attribute
+throughput/preemptions/restarts per tenant. All seeds pinned.
+
+    python tests/smoke_fleet_chaos.py
+"""
+
+import os
+import sys
+
+# Runs from a checkout without installation: sys.path[0] is tests/, so the
+# repo root must be appended (an installed distkeras_tpu still wins).
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# Tight-but-survivable budgets: the retry envelope must bridge the PS
+# subprocess's crash + cold restart (~2 s), not just a flaky frame.
+os.environ.setdefault("DKTPU_NET_TIMEOUT", "1.0")
+os.environ.setdefault("DKTPU_NET_RETRIES", "12")
+os.environ.setdefault("DKTPU_NET_BACKOFF", "0.05")
+os.environ.setdefault(
+    "DKTPU_NET_FAULTS",
+    "evict@3:2.5;partition@18:0.8;preempt@30:2;seed=3")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from distkeras_tpu import DataFrame, telemetry  # noqa: E402
+from distkeras_tpu.data.batching import make_batches  # noqa: E402
+from distkeras_tpu.fleet import (  # noqa: E402
+    DONE,
+    ElasticTraining,
+    FleetJob,
+    FleetScheduler,
+)
+from distkeras_tpu.models import Model  # noqa: E402
+from distkeras_tpu.models.mlp import MLP  # noqa: E402
+from distkeras_tpu.netps import ChaosProxy, PSServer  # noqa: E402
+from distkeras_tpu.netps import state as netps_state  # noqa: E402
+from distkeras_tpu.ops.losses import get_loss  # noqa: E402
+from distkeras_tpu.ops.optimizers import get_optimizer  # noqa: E402
+from distkeras_tpu.telemetry.report import build_report  # noqa: E402
+
+#: the corp PS subprocess's own plan: SIGKILL just before folding commit 6
+#: (mid-run for gamma's ~12 folds). Pinned, not random.
+PS_FAULTS = os.environ.get("FLEET_SMOKE_PS_FAULTS", "ps_crash@6;seed=3")
+
+LEASE_S = 1.0
+
+
+def _dataset(seed):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=4.0, size=(3, 4))
+    y = rng.integers(0, 3, size=512)
+    x = (centers[y] + rng.normal(scale=0.5, size=(512, 4))).astype(
+        np.float32)
+    return DataFrame({"features": x, "label": y.astype(np.int32)}), x, y
+
+
+def _runtime(df, seed, max_workers, num_epoch, **kw):
+    model = Model.build(MLP(hidden=(16,), num_outputs=3),
+                        jnp.zeros((1, 4), jnp.float32), seed=seed)
+    plan = make_batches(df, "features", "label", batch_size=16,
+                        num_workers=max_workers, window=4,
+                        num_epoch=num_epoch, shuffle=True, seed=seed)
+    return ElasticTraining(
+        model=model, tx=get_optimizer("sgd", 0.1),
+        loss_fn=get_loss("sparse_categorical_crossentropy"),
+        plan=plan, discipline="adag", seed=seed, lease_s=LEASE_S, **kw)
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch_ps(port, state_dir, faults_state):
+    import subprocess
+
+    # The smoke's own chaos plan must not leak into the server subprocess:
+    # it gets its OWN plan (ps_crash) + fired-state journal so the crash
+    # stays one-shot across the restart it causes.
+    drop = {"DKTPU_NET_FAULTS", "DKTPU_FAULTS_STATE"}
+    env = {k: v for k, v in os.environ.items() if k not in drop}
+    env.update({"JAX_PLATFORMS": "cpu",
+                "DKTPU_NET_FAULTS": PS_FAULTS,
+                "DKTPU_FAULTS_STATE": faults_state})
+    return subprocess.Popen(
+        [sys.executable, "-m", "distkeras_tpu.netps", "--host", "127.0.0.1",
+         "--port", str(port), "--discipline", "adag",
+         "--lease", str(LEASE_S),
+         "--state-dir", state_dir, "--snapshot-every", "10"],
+        env=env)
+
+
+def _accuracy(runtime, x, y):
+    trained = runtime.result()
+    return float((np.asarray(trained.predict(jnp.asarray(x))).argmax(-1)
+                  == y).mean())
+
+
+def _assert_exactly_once(pairs, label):
+    seen = set()
+    for key in pairs:
+        assert key not in seen, f"{label}: commit {key} folded twice"
+        seen.add(key)
+    return len(seen)
+
+
+def main() -> int:
+    import shutil
+    import subprocess
+    import threading
+    import time
+
+    state_dir = os.environ.get("DKTPU_FLEET_SMOKE_STATE",
+                               "/tmp/dktpu-fleet-ps-state")
+    shutil.rmtree(state_dir, ignore_errors=True)
+    os.makedirs(state_dir, exist_ok=True)
+    faults_state = os.path.join(state_dir, "faults.journal")
+
+    df_a, xa, ya = _dataset(10)
+    df_b, xb, yb = _dataset(11)
+    df_c, xc, yc = _dataset(12)
+
+    # Work volume: each job trains rounds x max_workers claim-queue items
+    # (the plan's full schedule), so epochs are kept small for CI wall
+    # time while leaving enough commits for every chaos index to land.
+    # acme/alpha: plain in-process PS.
+    rt_a = _runtime(df_a, seed=0, max_workers=4, num_epoch=3)
+    # bidco/beta: in-process PS behind the chaos proxy (the partition
+    # fault hits beta's wire; revocation still lands on the real server).
+    srv_b = PSServer(discipline="adag", lease_s=LEASE_S).start()
+    proxy = ChaosProxy(srv_b.endpoint).start()  # ambient DKTPU_NET_FAULTS
+    rt_b = _runtime(df_b, seed=1, max_workers=4, num_epoch=3,
+                    endpoint=proxy.endpoint, server=srv_b)
+    # corp/gamma: external PS subprocess (state dir + ps_crash) + babysitter.
+    ps_port = _free_port()
+    primary = _launch_ps(ps_port, state_dir, faults_state)
+    procs = [primary]
+    restarts = [0]
+    stop = threading.Event()
+
+    def babysit():
+        # Job.supervise's PS-restart duty, inlined: cold-restart the killed
+        # primary on the same state dir + port.
+        nonlocal primary
+        while not stop.is_set():
+            if primary.poll() is not None and primary.returncode != 0:
+                restarts[0] += 1
+                primary = _launch_ps(ps_port, state_dir, faults_state)
+                procs.append(primary)
+            time.sleep(0.1)
+
+    threading.Thread(target=babysit, daemon=True).start()
+    rt_c = _runtime(df_c, seed=2, max_workers=3, num_epoch=2,
+                    endpoint=f"127.0.0.1:{ps_port}")
+
+    sched = FleetScheduler(capacity=6, tick_s=0.02, preempt_grace=0.0)
+    job_a = sched.submit(FleetJob("alpha", "acme", rt_a,
+                                  priority=0, min_gang=2, max_workers=4))
+    job_b = sched.submit(FleetJob("beta", "bidco", rt_b,
+                                  priority=0, min_gang=2, max_workers=4))
+    sched.start()
+    try:
+        # The high-priority tenant arrives once the pool is warm: its gang
+        # only fits by preempting the incumbents down to their floors.
+        deadline = time.monotonic() + 120
+        while rt_a.progress() + rt_b.progress() < 4:
+            assert time.monotonic() < deadline, "fleet warmup stalled"
+            time.sleep(0.05)
+        job_c = sched.submit(FleetJob("gamma", "corp", rt_c,
+                                      priority=5, min_gang=2,
+                                      max_workers=3))
+        assert sched.wait(timeout=420), (
+            f"fleet did not finish: {sched.stats()}")
+    finally:
+        stop.set()
+        sched.close()
+        proxy.close()
+        crashed = any(p.poll() not in (0, None) for p in procs)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(timeout=10)
+
+    stats = sched.stats()
+    for job in (job_a, job_b, job_c):
+        assert job.state == DONE, f"{job.job_id} ended {job.state}"
+    assert sched.floor_violations == 0, "a job was shrunk below its floor"
+
+    # The chaos actually bit: every drill left its fingerprint.
+    events = telemetry.get().events()
+    fired = {e.get("fault") for e in events if e["kind"] == "fault_injected"}
+    assert "evict" in fired, "the worker-kill drill never fired"
+    assert "partition" in fired, "the partition drill never fired"
+    assert "preempt" in fired, "the forced-preemption drill never fired"
+    assert crashed and restarts[0] >= 1, (
+        "ps_crash never killed + restarted the corp PS")
+
+    # Preemption-driven shrink at corp/gamma's arrival, floors held,
+    # victims re-expanded once capacity freed.
+    victims = stats["acme/alpha"], stats["bidco/beta"]
+    total_preempt = sum(v["preemptions"] for v in victims)
+    assert total_preempt >= 2, f"incumbents were never preempted: {stats}"
+    assert any(v["expands"] >= 1 for v in victims), (
+        "no victim ever re-expanded")
+    reg = telemetry.get()
+    assert reg.counter("netps.revocations").value >= 2, (
+        "preemption never revoked a lease")
+
+    # Convergence per tenant.
+    accs = {"acme/alpha": _accuracy(rt_a, xa, ya),
+            "bidco/beta": _accuracy(rt_b, xb, yb),
+            "corp/gamma": _accuracy(rt_c, xc, yc)}
+    for jid, acc in accs.items():
+        assert acc > 0.85, f"{jid} collapsed under fleet chaos: {acc}"
+
+    # Exactly-once: in-process commit logs for alpha/beta; the on-disk
+    # journal (the only view a subprocess leaves) for gamma — which must
+    # also show nondecreasing epochs (cold restart keeps epoch 0).
+    n_a = _assert_exactly_once(
+        [(w, s) for w, s, _ in rt_a.server.commit_log], "alpha")
+    n_b = _assert_exactly_once(
+        [(w, s) for w, s, _ in rt_b.server.commit_log], "beta")
+    records = netps_state.read_journal(state_dir)
+    n_c = _assert_exactly_once(
+        [(int(r["wid"]), int(r["seq"])) for r in records], "gamma")
+    last_epoch = -1
+    for r in records:
+        assert int(r["e"]) >= last_epoch, "journal epoch went backwards"
+        last_epoch = int(r["e"])
+    assert n_c >= 5, "gamma's journal is implausibly short"
+
+    # Per-tenant attribution through the report CLI path.
+    jsonl = os.path.join(state_dir, "fleet_run.jsonl")
+    telemetry.write_jsonl(reg, jsonl)
+    rows = build_report(jsonl)["fleet"]
+    by_tenant = {}
+    for r in rows:
+        by_tenant.setdefault(r["tenant"], []).append(r)
+    assert set(by_tenant) >= {"acme", "bidco", "corp"}, (
+        f"report lost a tenant: {sorted(by_tenant)}")
+    for tenant, trows in by_tenant.items():
+        assert sum(r.get("commits", 0) for r in trows) > 0, (
+            f"{tenant} shows no throughput in the report")
+    attributed_preempts = sum(
+        r.get("preemptions", 0) for t in ("acme", "bidco")
+        for r in by_tenant[t])
+    assert attributed_preempts >= 2, (
+        "preemptions were not attributed to the victim tenants")
+
+    print("fleet chaos run: "
+          + " ".join(f"{jid}: acc={acc:.4f}" for jid, acc in accs.items())
+          + f" commits={n_a}/{n_b}/{n_c}"
+          + f" preemptions={total_preempt}"
+          + f" ps_restarts={restarts[0]}"
+          + f" revocations={reg.counter('netps.revocations').value:.0f}"
+          + f" floor_violations={sched.floor_violations}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

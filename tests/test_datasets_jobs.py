@@ -52,7 +52,7 @@ def test_dataset_determinism():
 def test_punchcard_roundtrip_and_job_render():
     pc = Punchcard(job_name="train", script="train.py",
                    hosts=["10.0.0.1", "10.0.0.2"], env={"FOO": "bar"},
-                   args=["--epochs", "3"])
+                   args=["--epochs", "3"], coordinator_port=8476)
     pc2 = Punchcard.from_json(pc.to_json())
     assert pc2.hosts == ["10.0.0.1", "10.0.0.2"]
 

@@ -555,6 +555,91 @@ def test_pre_eviction_retransmit_still_deduped_after_rejoin():
         srv.close()
 
 
+def test_administrative_lease_revocation_evicts_now():
+    """`PSServer.revoke` — the fleet scheduler's preemption primitive —
+    evicts immediately (no lease lapse to wait for), purges pending
+    stripe state, and leaves dedup state intact."""
+    srv = make_server(lease_s=60.0)  # lease never lapses on its own
+    try:
+        c = PSClient(srv.endpoint, worker_id=0, **FAST)
+        _, upd = c.join(init=[np.zeros(3, np.float32)])
+        assert c.commit([np.ones(3, np.float32)], upd).applied
+        assert srv.revoke(0) is True
+        assert srv.members() == [] and srv.evictions == 1
+        assert srv.revoke(0) is False  # not a member anymore: no-op
+        # The revoked worker's next commit is the discarded-window path;
+        # the client rejoins and the NEXT commit folds, seq intact.
+        res = c.commit([np.ones(3, np.float32)], upd)
+        assert res.evicted and not res.applied
+        _, upd = c.pull()
+        assert c.commit([np.ones(3, np.float32)], upd).applied
+        assert [seq for (_w, seq, _s) in srv.commit_log] == [0, 2]
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_revocation_shrink_then_expand_cycle_exactly_once():
+    """The fleet's elastic cycle at wire level: W workers commit, W/2 are
+    preempted via lease revocation mid-run (in-flight windows discarded),
+    then re-expand through the mid-run rejoin path and keep committing.
+    Exactly-once holds across the whole cycle, center progress (the
+    update counter) never regresses, and nobody's sequence double-folds."""
+    W = 4
+    srv = make_server(lease_s=60.0)
+    clients = [PSClient(srv.endpoint, worker_id=w, **FAST)
+               for w in range(W)]
+    progress = []
+
+    def commit_round():
+        for c in clients:
+            _, upd = c.pull()
+            res = c.commit([np.full(3, 0.1, np.float32)], upd)
+            assert res.applied or res.evicted
+            progress.append(srv.updates)
+
+    try:
+        for c in clients:
+            c.join(init=[np.zeros(3, np.float32)])
+        commit_round()               # everyone contributes
+        commit_round()
+        # Shrink: the scheduler preempts workers 2 and 3.
+        for w in (2, 3):
+            assert srv.revoke(w)
+        assert srv.members() == [0, 1]
+        evicted = 0
+        for c in clients[2:]:
+            _, upd = c.pull()  # transparently re-joins (expand half)...
+            res = c.commit([np.full(3, 0.1, np.float32)], upd)
+            # ...so this commit either folds (rejoin happened at the
+            # pull) or reports the discarded window; both are legal,
+            # neither double-folds.
+            evicted += int(res.evicted)
+            progress.append(srv.updates)
+        # Expand: the survivors AND the rejoined pair all commit again.
+        commit_round()
+        assert srv.rejoins == 2 and sorted(srv.members()) == [0, 1, 2, 3]
+        # Nondecreasing center progress across the whole cycle.
+        assert progress == sorted(progress)
+        assert srv.updates == len(srv.commit_log)
+        # Exactly-once: no (worker, seq) folded twice, no seq gaps abused.
+        seen = set()
+        for wid, seq, _st in srv.commit_log:
+            assert (wid, seq) not in seen, f"({wid}, {seq}) folded twice"
+            seen.add((wid, seq))
+        # Every survivor committed 3 times; the preempted pair lost at
+        # most the one discarded window each.
+        per_worker = {w: sum(1 for (wid, _s, _x) in srv.commit_log
+                             if wid == w) for w in range(W)}
+        assert per_worker[0] == 3 and per_worker[1] == 3
+        assert per_worker[2] >= 2 and per_worker[3] >= 2
+        assert srv.updates == sum(per_worker.values())
+    finally:
+        for c in clients:
+            c.close()
+        srv.close()
+
+
 def test_restarted_worker_resumes_commit_sequence():
     """A restarted worker process (fresh client, seq counter back at -1,
     same worker_id — the Job.supervise restart scenario) must keep

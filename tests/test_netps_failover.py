@@ -673,16 +673,21 @@ def test_punchcard_renders_the_ps_pair_and_endpoint_list():
                    ps={"discipline": "adag", "port": 7171, "lease": 5.0,
                        "state_dir": "/var/dktpu/ps",
                        "standby_host": "10.0.0.2"})
-    assert pc.ps_endpoint() == "10.0.0.1:7171,10.0.0.2:7172"
+    # The standby port is pool-allocated (the old primary+1 rule collided
+    # across jobs) and pinned into the card: every render agrees.
+    ep = pc.ps_endpoint()
+    sb_port = pc.ps["standby_port"]
+    assert ep == f"10.0.0.1:7171,10.0.0.2:{sb_port}"
+    assert pc.ps_endpoint() == ep
     job = Job(pc)
     ps_cmd = job.render_ps_command()
     assert "--state-dir /var/dktpu/ps" in ps_cmd
     sb_cmd = job.render_standby_command()
     assert "--standby 10.0.0.1:7171" in sb_cmd
-    assert "--port 7172" in sb_cmd
+    assert f"--port {sb_port}" in sb_cmd
     assert "--state-dir /var/dktpu/ps.standby" in sb_cmd
     for cmd in job.launch(dry_run=True):
-        assert "DKTPU_PS_ENDPOINT=10.0.0.1:7171,10.0.0.2:7172" in cmd
+        assert f"DKTPU_PS_ENDPOINT={ep}" in cmd
     # No standby: single endpoint, no standby line — PR 4 behavior intact.
     bare = Job(Punchcard(job_name="j", script="s.py", hosts=["h"],
                          ps={"port": 7077}))
