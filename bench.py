@@ -777,6 +777,108 @@ def _measure_spmd_transformer(name, *, num_layers, d_model, num_heads, d_ff,
     return rec
 
 
+def _measure_serving(name, *, feature_dim=64, hidden=256, num_classes=10,
+                     qps_levels=(50, 200, 800), duration_s=2.0,
+                     max_wait_ms=2.0, buckets="1,4,16,64",
+                     load_threads=8):
+    """Config #9 — the serving plane's latency/throughput frontier: a
+    loopback :class:`ServingFrontend` over a small MLP, open-loop offered
+    load swept across ``qps_levels``, client-observed p50/p99 per level.
+    The headline value is the best achieved QPS; the ``latency_curve``
+    list is the real deliverable — it shows where micro-batching holds
+    p99 flat and where admission control starts shedding instead of
+    letting the queue eat the tail."""
+    import threading
+
+    import numpy as np
+    from flax import linen as nn
+
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.serving import (
+        ModelRegistry,
+        ServeClient,
+        ServingError,
+        ServingFrontend,
+        parse_buckets,
+    )
+
+    class _MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(num_classes)(nn.relu(nn.Dense(hidden)(x)))
+
+    model = Model.build(_MLP(), np.zeros((2, feature_dim), np.float32))
+    registry = ModelRegistry(model, parse_buckets(buckets))
+    frontend = ServingFrontend(registry,
+                               max_wait_s=max_wait_ms / 1e3).start()
+    curve = []
+    try:
+        for offered in qps_levels:
+            lat: list[float] = []
+            shed = [0]
+            errs = [0]
+            lock = threading.Lock()
+            stop = time.perf_counter() + duration_s
+            interval = load_threads / float(offered)
+
+            def _load(k, interval=interval, stop=stop, lat=lat,
+                      shed=shed, errs=errs):
+                client = ServeClient(frontend.endpoint, timeout=5.0,
+                                     retries=2, backoff=0.01)
+                x = np.random.default_rng(k).standard_normal(
+                    (1, feature_dim)).astype(np.float32)
+                nxt = time.perf_counter() + (k / load_threads) * interval
+                while True:
+                    now = time.perf_counter()
+                    if now >= stop:
+                        break
+                    if now < nxt:
+                        time.sleep(min(nxt - now, 0.005))
+                        continue
+                    nxt += interval
+                    t0 = time.perf_counter()
+                    try:
+                        client.infer(x)
+                        with lock:
+                            lat.append(time.perf_counter() - t0)
+                    except ServingError:
+                        with lock:
+                            shed[0] += 1
+                    except Exception:
+                        with lock:
+                            errs[0] += 1
+                client.close()
+
+            threads = [threading.Thread(target=_load, args=(k,))
+                       for k in range(load_threads)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            lat.sort()
+            n = len(lat)
+            curve.append({
+                "offered_qps": offered,
+                "achieved_qps": round(n / dt, 1) if dt > 0 else 0.0,
+                "p50_ms": round(lat[n // 2] * 1e3, 3) if n else None,
+                "p99_ms": (round(lat[min(n - 1, int(n * 0.99))] * 1e3, 3)
+                           if n else None),
+                "answered": n, "shed": shed[0], "errors": errs[0],
+            })
+    finally:
+        frontend.close()
+        registry.close()
+    best = max((c["achieved_qps"] for c in curve), default=0.0)
+    return {
+        "metric": f"{name}_requests_per_sec",
+        "value": round(best, 1), "unit": "requests/s",
+        "latency_curve": curve,
+        "compiles": registry.compiles(),
+    }
+
+
 def scaling_sweep():
     """The north-star gate's measurement machinery (BASELINE.md #3): CIFAR-10
     CNN under AEASGD at num_workers = 1, 2, 4, ..., N over the visible devices,
@@ -1028,6 +1130,15 @@ def main():
                          vocab=8192, seq_len=128, batch=4, window=2,
                          rounds=12)))
 
+    # 9 - the serving plane: p50/p99 latency vs offered QPS over a loopback
+    # micro-batching frontend (distkeras_tpu/serving/). Open-loop load at
+    # each level; the curve shows where bucketed batching holds p99 flat
+    # and where admission control sheds instead of letting the queue eat
+    # the tail.
+    configs.append(("serving_latency", None, "serving",
+                    dict(feature_dim=64, hidden=256, num_classes=10,
+                         qps_levels=(50, 200, 800), duration_s=2.0)))
+
     # Optional subset for debugging: BENCH_CONFIGS=cifar10,resnet python bench.py
     only = [s for s in os.environ.get("BENCH_CONFIGS", "").split(",") if s]
     if only:
@@ -1051,6 +1162,8 @@ def main():
                         rec = _measure_async_transformer(name, **kw)
                     elif discipline == "netps_transformer":
                         rec = _measure_netps_transformer(name, **kw)
+                    elif discipline == "serving":
+                        rec = _measure_serving(name, **kw)
                     else:
                         rec = _measure(name, model_fn, discipline, **kw)
                 break

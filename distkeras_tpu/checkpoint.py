@@ -47,6 +47,54 @@ def _abstract(tree):
     return jax.tree.map(conv, tree)
 
 
+def read_meta(directory: str, step: int) -> Optional[dict]:
+    """The ``meta`` sidecar saved with ``step`` under ``directory``
+    (None when absent or unparsable) — shared by :meth:`Checkpointer.meta`
+    and the manager-less scans below."""
+    import json
+
+    path = os.path.join(directory, "meta", f"{step}.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def scan_steps(directory: str) -> list[int]:
+    """Integer-named step directories under ``directory``, newest first,
+    from a plain listdir — no CheckpointManager construction, so a poller
+    (the serving ModelRegistry) can afford it every few seconds. Orbax's
+    in-progress tmp directories carry a suffix and are skipped."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    steps = [int(n) for n in names
+             if n.isdigit() and os.path.isdir(os.path.join(directory, n))]
+    return sorted(steps, reverse=True)
+
+
+def resume_candidates(steps_desc, has_meta) -> list[int]:
+    """The newest-intact-first candidate order shared by
+    ``Trainer._resume_from_checkpoint`` and the serving registry: steps
+    whose meta sidecar is present and parsable, newest first; when NO step
+    has one (metaless save paths) every step stays a candidate rather than
+    refusing to resume at all."""
+    with_meta = [s for s in steps_desc if has_meta(s)]
+    return with_meta or list(steps_desc)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Newest intact-looking step in ``directory`` (None when empty): the
+    first entry of the sidecar-preferred candidate walk over a cheap
+    directory scan. Callers still ``restore(verify=True)`` the winner —
+    this picks the candidate, the digest check vets the payload."""
+    cands = resume_candidates(scan_steps(directory),
+                              lambda s: read_meta(directory, s) is not None)
+    return cands[0] if cands else None
+
+
 class Checkpointer:
     """Rolling checkpoints of training state keyed by fold-round number."""
 
@@ -171,14 +219,7 @@ class Checkpointer:
 
     def meta(self, step: int) -> Optional[dict]:
         """The ``meta`` dict saved with ``step`` (None if absent)."""
-        import json
-
-        path = os.path.join(self.directory, "meta", f"{step}.json")
-        try:
-            with open(path) as f:
-                return json.load(f)
-        except (OSError, ValueError):
-            return None
+        return read_meta(self.directory, step)
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()

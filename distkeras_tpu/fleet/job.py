@@ -47,14 +47,25 @@ class FleetJob:
     it (full preemption drains it entirely and re-queues it instead).
     ``max_workers`` bounds elastic expansion. ``priority``: higher wins;
     placement within a priority level is FIFO by submission.
+
+    ``kind`` marks what the job serves the cluster as: ``"training"``
+    (default) jobs are ordinary preemption victims; ``"serving"`` jobs are
+    latency-bound — the scheduler may shrink them down to ``min_gang`` (the
+    preemption floor protecting tail latency) but never fully drains them
+    for a higher-priority arrival.
     """
 
     def __init__(self, name: str, tenant: str, runtime,
                  priority: int = 0, min_gang: int = 1,
-                 max_workers: Optional[int] = None):
+                 max_workers: Optional[int] = None,
+                 kind: str = "training"):
+        if kind not in ("training", "serving"):
+            raise ValueError(
+                f"kind must be 'training' or 'serving', got {kind!r}")
         self.name = str(name)
         self.tenant = str(tenant)
         self.runtime = runtime
+        self.kind = kind
         self.priority = int(priority)
         self.min_gang = int(min_gang)
         self.max_workers = int(max_workers if max_workers is not None

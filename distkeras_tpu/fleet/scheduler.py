@@ -512,6 +512,13 @@ class FleetScheduler:
             for job in self._victims(req_priority):
                 if taken >= n:
                     break
+                if getattr(job, "kind", "training") == "serving":
+                    # Serving jobs shrink to their floor (above) but are
+                    # never fully drained: a drain would take the replica
+                    # set offline, and tail latency is the whole contract.
+                    telemetry.counter(
+                        "fleet.serving_drains_refused").add(1)
+                    continue
                 active = self._active(job)
                 if active == 0:
                     continue

@@ -97,8 +97,18 @@ CODECS = (CODEC_NONE, CODEC_BF16, CODEC_INT8)
 #: ``replication`` advertises the ``replicate``/``fence`` ops a warm
 #: standby tails the primary's journal stream through (``netps/standby.py``)
 #: — a peer without the bit gets a typed protocol rejection, never a hang.
+#: ``serving`` advertises the online-inference ops (``infer``/``stats``,
+#: ``distkeras_tpu/serving/``) — a frontend answers them, a PS rejects
+#: them with the usual typed unknown-op error; the bit lets a probing
+#: client tell the two apart without sending a payload.
 CAPS = {"codecs": list(CODECS), "striping": True, "shm": True,
-        "replication": True}
+        "replication": True, "serving": True}
+
+#: serving-plane ops carried in ``header["op"]`` over the SAME frame
+#: format (length prefix, crc32, request-id echo) — the serving frontend
+#: speaks the wire protocol, not a second one.
+OP_INFER = "infer"
+OP_STATS = "stats"
 
 
 # ---------------------------------------------------------------------------

@@ -298,22 +298,31 @@ class ModelPredictor(Predictor):
 
         from distkeras_tpu import telemetry
 
-        pending_gauge = telemetry.get().gauge("predict.pending_rows")
+        tele = telemetry.get()
+        pending_gauge = tele.gauge("predict.pending_rows")
         for microbatch in source:
-            mb = np.asarray(microbatch)
-            sizes.append(len(mb))
-            if mb.ndim > 1:
-                # Even a zero-row block carries the feature tail (e.g. an
-                # empty shard's [0, d] column) — keep it as the spec hint
-                # for empty output blocks on spec-less models.
-                feat_hint[0] = mb
-            if len(mb):  # an empty poll from a raw stream has no rows
-                pending.append(mb)
-            if pending_rows() >= self.chunk_size:
-                compute(flush=False)
-            # Rows buffered awaiting a forward pass: a gauge pinned near
-            # chunk_size means the producer outruns the compute chunking.
-            pending_gauge.set(pending_rows())
+            # Per-microbatch latency span, the streaming twin of the batch
+            # path's ``predict.chunk``: ingest + any compute it triggers
+            # (the emit walk stays outside — a slow CONSUMER must not read
+            # as predictor latency). The inner ``predict.chunk`` spans
+            # (fired by _predict_array) still time each forward pass.
+            with tele.span("predict.stream_microbatch"):
+                mb = np.asarray(microbatch)
+                sizes.append(len(mb))
+                if mb.ndim > 1:
+                    # Even a zero-row block carries the feature tail (e.g.
+                    # an empty shard's [0, d] column) — keep it as the spec
+                    # hint for empty output blocks on spec-less models.
+                    feat_hint[0] = mb
+                if len(mb):  # an empty poll from a raw stream has no rows
+                    pending.append(mb)
+                    tele.counter("predict.stream_rows").add(float(len(mb)))
+                if pending_rows() >= self.chunk_size:
+                    compute(flush=False)
+                # Rows buffered awaiting a forward pass: a gauge pinned
+                # near chunk_size means the producer outruns the compute
+                # chunking.
+                pending_gauge.set(pending_rows())
             yield from drain()
         compute(flush=True)
         pending_gauge.set(pending_rows())

@@ -81,11 +81,20 @@ _KINDS = frozenset({
 #: running job exactly as a capacity squeeze would — lease revocation,
 #: shrink floor at the victim's min gang, full drain + requeue when the
 #: floor is already reached (``distkeras_tpu/fleet/scheduler.py``).
+#: ``serve_slow@F:S`` and ``serve_drop@F`` are consumed by the serving
+#: frontend (``distkeras_tpu/serving/frontend.py``), indexing accepted
+#: inference requests process-wide: ``serve_slow`` holds request F's
+#: reply for S seconds (a wedged replica — clients must ride it out or
+#: walk the replica list), ``serve_drop`` kills request F's connection
+#: without a reply (the client sees a transport failure and fails over;
+#: the shed-before-accept contract still answers every ACCEPTED request
+#: whose connection survives).
 _NET_KINDS = frozenset({
     "delay", "drop", "dup", "truncate", "partition", "evict",
     "delay_r", "drop_r", "dup_r", "truncate_r",
     "shm_delay", "shm_corrupt",
     "ps_crash", "ps_hang", "preempt",
+    "serve_slow", "serve_drop",
 })
 
 

@@ -73,7 +73,8 @@ class EnvVar:
     kind: str
     default: object
     doc: str
-    # "observability" | "resilience" | "network" | "fleet" | "data" | "interop"
+    # "observability" | "resilience" | "network" | "fleet" | "serving" |
+    # "data" | "interop"
     category: str
 
 
@@ -184,7 +185,9 @@ ENV_REGISTRY: dict = _declare(
            "`dup`/`truncate`/`partition`/`evict`, `_r` suffix = reply "
            "direction; `shm_delay`/`shm_corrupt` hit the shared-memory "
            "ring; `ps_crash`/`ps_hang` hit the server process; `preempt` "
-           "drives the FleetScheduler's forced-preemption drill) "
+           "drives the FleetScheduler's forced-preemption drill; "
+           "`serve_slow`/`serve_drop` hit the serving frontend's request "
+           "stream) "
            "separated by `;`, e.g. `delay@3:0.2;drop@5;partition@7:2`. "
            "Empty = no injection. See docs/RESILIENCE.md.",
            "network"),
@@ -246,6 +249,37 @@ ENV_REGISTRY: dict = _declare(
            "Per-job budget of crashed-worker restarts the FleetScheduler "
            "performs before declaring the job failed and draining it.",
            "fleet"),
+    EnvVar("DKTPU_SERVE_MAX_WAIT_MS", "float", 5.0,
+           "Latency budget (milliseconds) the serving micro-batcher waits "
+           "to coalesce concurrent requests into one batch before "
+           "dispatching whatever it holds; 0 = dispatch immediately "
+           "(batch = whatever arrived together).",
+           "serving"),
+    EnvVar("DKTPU_SERVE_BUCKETS", "str", "1,4,16,64,256",
+           "Comma-separated ascending batch-size buckets the serving "
+           "frontend pads every micro-batch up to; jit compiles one "
+           "program per bucket at warmup, so ragged request batches never "
+           "retrace. The largest bucket is also the per-batch row cap.",
+           "serving"),
+    EnvVar("DKTPU_SERVE_QUEUE", "int", 256,
+           "Admission-control bound on rows queued in the serving "
+           "frontend; a request that would overflow it is shed with a "
+           "typed `overloaded` reply BEFORE being accepted (an accepted "
+           "request is never silently dropped).",
+           "serving"),
+    EnvVar("DKTPU_SERVE_DEADLINE_MS", "float", None,
+           "Optional per-request serving deadline (milliseconds, measured "
+           "from admission): a queued request older than this is answered "
+           "with a typed `deadline` reply instead of being computed — "
+           "shedding work nobody is waiting for anymore. Unset = no "
+           "deadline.",
+           "serving"),
+    EnvVar("DKTPU_SERVE_POLL_S", "float", 2.0,
+           "Seconds between ModelRegistry checkpoint-directory polls for "
+           "hot-swap candidates; each newer intact step is restored "
+           "(sha256-verified), warmup-probed, and swapped in atomically "
+           "between batches.",
+           "serving"),
     EnvVar("DKTPU_NO_NATIVE", "bool", False,
            "`1` disables the native (C++) data-plane kernels; every gather "
            "falls back to numpy (bit-identical, slower).",

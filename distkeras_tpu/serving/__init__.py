@@ -1,0 +1,54 @@
+"""Online serving plane: continuous micro-batching over the netps wire.
+
+The training side of this repo reproduces dist-keras' distributed
+optimizers; this package is the north-star's other half — "serving heavy
+traffic": a request frontend on the hardened wire protocol with
+
+* **continuous micro-batching** — concurrent requests coalesce up to a
+  latency budget and pad to bucketed shapes so jit never retraces
+  (``serving/batcher.py``, ``serving/model.py``);
+* **admission control** — bounded queue, shed-before-accept, typed
+  overload/deadline replies; an accepted request is never silently
+  dropped (``serving/errors.py``);
+* **hot-swap checkpoints** — a registry watches the trainer's checkpoint
+  directory, sha256-verifies and warmup-probes each new step, and swaps
+  atomically between batches (``serving/registry.py``);
+* **HA replica sets** — N replicas as a first-class fleet tenant with a
+  preemption floor; clients walk the endpoint list on failure
+  (``serving/replica.py``, ``serving/frontend.py``).
+
+See docs/SERVING.md for the batching model, the shed contract, and the
+failure matrix.
+"""
+
+from distkeras_tpu.serving.batcher import (
+    MicroBatcher,
+    bucket_for,
+    parse_buckets,
+)
+from distkeras_tpu.serving.errors import (
+    DeadlineExceededError,
+    ModelUnavailableError,
+    OverloadedError,
+    ServingError,
+)
+from distkeras_tpu.serving.frontend import ServeClient, ServingFrontend
+from distkeras_tpu.serving.model import BucketedModel
+from distkeras_tpu.serving.registry import ModelRegistry
+from distkeras_tpu.serving.replica import ServingReplicaSet, ServingService
+
+__all__ = [
+    "BucketedModel",
+    "DeadlineExceededError",
+    "MicroBatcher",
+    "ModelRegistry",
+    "ModelUnavailableError",
+    "OverloadedError",
+    "ServeClient",
+    "ServingError",
+    "ServingFrontend",
+    "ServingReplicaSet",
+    "ServingService",
+    "bucket_for",
+    "parse_buckets",
+]

@@ -1,0 +1,121 @@
+"""Bucketed-shape inference: one jit program per batch bucket, zero
+retraces after warmup.
+
+A live request stream produces ragged batch sizes — 3 rows now, 17 rows
+next — and a naive ``jit(apply)`` would recompile on every new size,
+turning tail latency into compile latency. Instead every micro-batch is
+padded up to the smallest bucket from ``DKTPU_SERVE_BUCKETS`` that fits
+it, so the jit cache holds exactly ``len(buckets)`` programs, all compiled
+at warmup (SNIPPETS.md [2]'s sharding-spec helpers are the same idea
+applied to shape buckets). A compile observed *after* warmup is a contract
+violation and fires the ``serving.retrace_after_warmup`` counter — the
+chaos smoke asserts it stays at zero.
+
+Compiles are counted with a trace-time Python side effect (the counter in
+the traced function body runs once per compilation, never per call), which
+is version-proof against jax's private cache-introspection surface.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from distkeras_tpu.serving.batcher import bucket_for
+
+
+class BucketedModel:
+    """A :class:`~distkeras_tpu.models.base.Model` wrapped for serving:
+    padded-bucket jit forward, warmup over every bucket, retrace
+    accounting. Parameters are swappable (:meth:`set_params`) without
+    recompiling — the cache is keyed on shapes, and a hot-swapped
+    checkpoint has the same tree structure by construction."""
+
+    def __init__(self, model, buckets: Sequence[int]):
+        import jax
+
+        self.model = model
+        self.buckets = tuple(buckets)
+        self.params = model.params
+        self._compiles = 0
+        self._warmed = False
+
+        def _traced(params, *inputs):
+            # Trace-time side effect: runs once per compilation. After
+            # warmup this must be unreachable — every shape in flight is a
+            # bucket shape already compiled.
+            self._on_trace()
+            return model.apply(params, *inputs, train=False)
+
+        self._fwd = jax.jit(_traced)
+
+    def _on_trace(self) -> None:
+        from distkeras_tpu import telemetry
+
+        self._compiles += 1
+        if self._warmed:
+            telemetry.counter("serving.retrace_after_warmup").add(1)
+            telemetry.event("serve_retrace", {"compiles": self._compiles})
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def warmup(self) -> int:
+        """Compile every bucket's program on zeros shaped from the model's
+        ``sample_spec`` (its build-time input signature). Returns the
+        number of programs compiled; after this, any further compile is a
+        counted retrace. Doubles as the hot-swap *probe*: restored params
+        that cannot produce a finite forward pass raise here, and the
+        registry keeps the old version."""
+        spec = self.model.sample_spec
+        if spec is None:
+            raise ValueError(
+                "BucketedModel.warmup needs model.sample_spec (models from "
+                "Model.build carry one) to know the per-row input shapes")
+        before = self._compiles
+        for b in self.buckets:
+            inputs = tuple(np.zeros((b,) + tuple(s.shape[1:]), s.dtype)
+                           for s in spec)
+            out = np.asarray(self._fwd(self.params, *inputs))
+            if not np.all(np.isfinite(out)):
+                raise ValueError(
+                    f"warmup probe produced non-finite outputs at bucket "
+                    f"{b}: refusing to serve these parameters")
+        self._warmed = True
+        return self._compiles - before
+
+    @property
+    def warmed(self) -> bool:
+        return self._warmed
+
+    def compiles(self) -> int:
+        """Total compilations so far (warmup included)."""
+        return self._compiles
+
+    def set_params(self, params) -> None:
+        """Swap in new parameters — an attribute store, atomic under the
+        GIL; the next batch picks them up, no recompile (same tree, same
+        shapes)."""
+        self.params = params
+
+    # -- inference ----------------------------------------------------------
+
+    def infer(self, arrays: Sequence[np.ndarray],
+              rows: Optional[int] = None) -> np.ndarray:
+        """Forward ``arrays`` (leading axis = rows) padded up to the
+        smallest fitting bucket; the padding rows are sliced back off the
+        output, so callers only ever see their own rows."""
+        arrays = tuple(np.asarray(a) for a in arrays)
+        n = int(arrays[0].shape[0]) if rows is None else int(rows)
+        bucket = bucket_for(n, self.buckets)
+        if bucket is None:
+            raise ValueError(
+                f"batch of {n} rows exceeds the largest bucket "
+                f"{self.buckets[-1]} (the batcher caps batches below this)")
+        if bucket != n:
+            arrays = tuple(
+                np.concatenate(
+                    [a, np.zeros((bucket - n,) + a.shape[1:], a.dtype)])
+                for a in arrays)
+        out = np.asarray(self._fwd(self.params, *arrays))
+        return out[:n]

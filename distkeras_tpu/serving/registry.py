@@ -1,0 +1,155 @@
+"""Multi-model hot-swap: serve version N while version N+1 proves itself.
+
+The :class:`ModelRegistry` watches a checkpoint directory (the one the
+trainer saves into) with the cheap manager-less scan from
+``checkpoint.latest_step`` — the same newest-intact-first walk the
+trainer's resume uses, so the two planes agree on which step is "the
+latest good one". Each newer candidate step is restored through
+``Checkpointer.restore(verify=True)`` (the sha256 digest sidecar vets the
+payload), wrapped in a fresh :class:`~distkeras_tpu.serving.model.
+BucketedModel`, and **warmup-probed** — all buckets compiled, outputs
+finite — before it is swapped in. The swap itself is an atomic reference
+replacement under the registry lock, taken by the frontend's dispatch
+thread *between* batches: no batch ever sees half-old half-new weights,
+and the old version keeps answering until the instant the new one is
+proven.
+
+A candidate that fails restore or probe is remembered and skipped
+(``serving.swap_failures``); the registry falls back to the next-newest
+candidate, mirroring ``Trainer._resume_from_checkpoint``'s corruption
+fallback, and keeps serving the incumbent either way.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from distkeras_tpu import checkpoint as ckpt_mod
+from distkeras_tpu.runtime import config
+from distkeras_tpu.serving.model import BucketedModel
+
+
+class ModelRegistry:
+    """Owns the live :class:`BucketedModel` + its version (checkpoint
+    step; -1 = the build-time params, nothing restored yet) and the
+    polling thread that hot-swaps newer verified checkpoints in."""
+
+    def __init__(self, model, buckets, directory: Optional[str] = None,
+                 poll_s: Optional[float] = None, warmup: bool = True):
+        self.directory = directory
+        self.poll_s = float(config.env_float("DKTPU_SERVE_POLL_S")
+                            if poll_s is None else poll_s)
+        self._model = model
+        self.buckets = tuple(buckets)
+        self._lock = threading.Lock()
+        self._bucketed = BucketedModel(model, self.buckets)
+        if warmup:
+            self._bucketed.warmup()
+        self._version = -1
+        self._failed: set[int] = set()
+        self._ckpt = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- serving side -------------------------------------------------------
+
+    def current(self) -> tuple[BucketedModel, int]:
+        """The live (model, version) pair — one atomic read; the dispatch
+        thread calls this per batch, so a swap lands cleanly between two
+        batches and never inside one."""
+        with self._lock:
+            return self._bucketed, self._version
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def compiles(self) -> int:
+        with self._lock:
+            return self._bucketed.compiles()
+
+    # -- watch side ---------------------------------------------------------
+
+    def poll_once(self) -> bool:
+        """One scan of the checkpoint directory; restores + probes + swaps
+        the newest intact candidate newer than the live version. Returns
+        whether a swap happened."""
+        from distkeras_tpu import telemetry
+
+        if self.directory is None:
+            return False
+        steps = ckpt_mod.scan_steps(self.directory)
+        candidates = ckpt_mod.resume_candidates(
+            steps, lambda s: ckpt_mod.read_meta(self.directory, s)
+            is not None)
+        for step in candidates:
+            if step <= self._version or step in self._failed:
+                continue
+            try:
+                candidate = self._load_and_probe(step)
+            except Exception as e:  # noqa: BLE001 - fall back to next step
+                self._failed.add(step)
+                telemetry.counter("serving.swap_failures").add(1)
+                telemetry.event("serve_swap_failed", {
+                    "step": step, "error": repr(e)})
+                import warnings
+
+                warnings.warn(
+                    f"serving hot-swap candidate step {step} rejected "
+                    f"({type(e).__name__}: {e}); still serving version "
+                    f"{self._version}", stacklevel=2)
+                continue
+            with self._lock:
+                self._bucketed = candidate
+                self._version = step
+            telemetry.counter("serving.swaps").add(1)
+            telemetry.event("serve_swap", {"step": step})
+            return True
+        return False
+
+    def _load_and_probe(self, step: int) -> BucketedModel:
+        """Restore ``step`` (digest-verified) into the model's parameter
+        structure and warmup-probe a fresh bucketed wrapper; any failure
+        raises and the caller keeps the incumbent."""
+        if self._ckpt is None:
+            from distkeras_tpu.checkpoint import Checkpointer
+
+            self._ckpt = Checkpointer(self.directory)
+        params = self._ckpt.restore(
+            self._model.params, step=step, verify=True)
+        candidate = BucketedModel(
+            self._model.with_params(params), self.buckets)
+        candidate.warmup()  # the probe: compiles + finiteness, or raises
+        return candidate
+
+    def start(self) -> None:
+        """Launch the polling thread (idempotent)."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.is_set():
+                try:
+                    self.poll_once()
+                except Exception:  # noqa: BLE001 - poller must survive
+                    pass
+                self._stop.wait(self.poll_s)
+
+        self._thread = threading.Thread(
+            target=_loop, name="serve-registry", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        if self._ckpt is not None:
+            try:
+                self._ckpt.close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+            self._ckpt = None

@@ -171,6 +171,61 @@ def fleet_attribution(summary: dict) -> list[dict]:
     return [jobs[k] for k in sorted(jobs)]
 
 
+def _hist_quantile(h: dict, q: float) -> float:
+    """Bucket-resolution quantile from a histogram *snapshot* (the same
+    walk as ``Histogram.quantile``, but over the serialized form a JSONL
+    summary carries)."""
+    from distkeras_tpu.telemetry.core import BUCKET_BOUNDS
+
+    buckets = h.get("buckets", [])
+    count = h.get("count", 0)
+    if not count:
+        return 0.0
+    target = q * count
+    seen = 0
+    for i, c in enumerate(buckets):
+        seen += c
+        if seen >= target and c:
+            return (BUCKET_BOUNDS[i] if i < len(BUCKET_BOUNDS)
+                    else _hist_max(h))
+    return _hist_max(h)
+
+
+#: ``serving.*`` counter names surfaced in the Serving report section —
+#: the request-accounting vocabulary from ``distkeras_tpu/serving/``.
+_SERVING_COUNTERS = (
+    "accepted", "answered", "shed", "deadline_drops", "batches",
+    "batched_rows", "padded_rows", "swaps", "swap_failures",
+    "retrace_after_warmup", "client_failovers", "conn_errors",
+)
+
+
+def serving_summary(summary: dict) -> Optional[dict]:
+    """Roll up the serving plane's metrics: request accounting (accepted /
+    answered / shed — the shed-before-accept contract is checkable right
+    here), latency quantiles from the ``serving.latency`` histogram, batch
+    padding overhead, and hot-swap counts. None when the run served
+    nothing."""
+    out: dict = {}
+    for name in _SERVING_COUNTERS:
+        v = summary.get("counters", {}).get(f"serving.{name}")
+        if v is not None:
+            out[name] = v
+    lat = summary.get("spans", {}).get("serving.latency")
+    if lat and lat.get("count"):
+        out["latency_count"] = lat["count"]
+        out["latency_mean_s"] = lat.get("mean",
+                                        lat.get("total", 0.0) / lat["count"])
+        out["latency_p50_s"] = _hist_quantile(lat, 0.50)
+        out["latency_p99_s"] = _hist_quantile(lat, 0.99)
+        out["latency_max_s"] = _hist_max(lat)
+    depth = summary.get("gauges", {}).get("serving.queue_depth")
+    if depth is not None:
+        out["queue_depth_last"] = depth.get("value")
+        out["queue_depth_max"] = depth.get("max")
+    return out or None
+
+
 def straggler_table(rounds: list[dict], k: float = STRAGGLER_K) -> list[dict]:
     """Rounds whose wall time exceeds ``k`` x the median round time (plus
     any rounds the live monitor already flagged). Burst-tail rounds
@@ -219,6 +274,7 @@ def build_report(path: str, k: float = STRAGGLER_K) -> dict:
         "staleness": staleness_summary(rounds),
         "stragglers": straggler_table(rounds, k),
         "fleet": fleet_attribution(merged),
+        "serving": serving_summary(merged),
         "losses": [r["loss"] for r in rounds if "loss" in r],
     }
 
@@ -297,6 +353,29 @@ def render_report(report: dict) -> str:
               f"{r.get('shrinks', 0):>7.0f} {r.get('expands', 0):>7.0f} "
               f"{r.get('restarts', 0):>8.0f} "
               f"{r.get('preempt_debt', 0.0):>5.0f}\n")
+
+    if report.get("serving"):
+        sv = report["serving"]
+        w("\n## Serving\n")
+        w(f"accepted: {sv.get('accepted', 0):.0f}   "
+          f"answered: {sv.get('answered', 0):.0f}   "
+          f"shed: {sv.get('shed', 0):.0f}   "
+          f"deadline drops: {sv.get('deadline_drops', 0):.0f}\n")
+        if "latency_count" in sv:
+            w(f"latency: p50 {_fmt_seconds(sv['latency_p50_s'])}   "
+              f"p99 {_fmt_seconds(sv['latency_p99_s'])}   "
+              f"mean {_fmt_seconds(sv['latency_mean_s'])}   "
+              f"max {_fmt_seconds(sv['latency_max_s'])}\n")
+        if sv.get("batches"):
+            rows = sv.get("batched_rows", 0)
+            pad = sv.get("padded_rows", 0)
+            frac = pad / (rows + pad) if (rows + pad) else 0.0
+            w(f"batches: {sv['batches']:.0f}   rows: {rows:.0f}   "
+              f"padding overhead: {frac * 100:.1f}%\n")
+        w(f"hot-swaps: {sv.get('swaps', 0):.0f} "
+          f"({sv.get('swap_failures', 0):.0f} rejected)   "
+          f"retraces after warmup: "
+          f"{sv.get('retrace_after_warmup', 0):.0f}\n")
 
     w("\n## Stragglers\n")
     if report["stragglers"]:

@@ -305,15 +305,16 @@ class Trainer:
         back to the previous step. Returns ``(state, start, step_offset)``;
         ``state`` is None when nothing was restorable (fresh start)."""
         from distkeras_tpu import telemetry
+        from distkeras_tpu.checkpoint import resume_candidates
 
         steps = ckpt.steps_desc()
-        with_meta = [s for s in steps if ckpt.meta(s)]
-        candidates = with_meta or steps
-        if with_meta and with_meta[0] != steps[0]:
+        candidates = resume_candidates(
+            steps, lambda s: ckpt.meta(s) is not None)
+        if steps and candidates[0] != steps[0]:
             telemetry.counter("resilience.ckpt_fallback_steps").add(1)
             warnings.warn(
                 f"latest checkpoint step {steps[0]} has a missing/corrupt "
-                f"meta sidecar; falling back to step {with_meta[0]}, the "
+                f"meta sidecar; falling back to step {candidates[0]}, the "
                 "most recent step with an intact sidecar", stacklevel=2)
         last_err = None
         for step in candidates:

@@ -312,6 +312,68 @@ def test_dead_ring_with_zero_retries_falls_back_on_next_rpc():
         srv.close()
 
 
+def test_concurrent_shm_clients_isolated_and_one_ring_dies_mid_call():
+    """Two frontends (clients) attached to ONE PS host over the ring: each
+    client owns its own slot pair, so concurrent commits from both can
+    never interleave inside a frame (per-client slot isolation — the
+    center ends at the exact sum of both streams, exactly-once intact);
+    and when ONE client's ring dies mid-call, that client alone falls back
+    to TCP while the sibling keeps speaking shm — ring death is a
+    per-connection event, not a host event."""
+    srv = PSServer(discipline="downpour", transport="shm").start()
+    c0 = PSClient(srv.endpoint, worker_id=0, transport="shm", **FAST)
+    c1 = PSClient(srv.endpoint, worker_id=1, transport="shm", **FAST)
+    try:
+        init = [np.zeros(5, np.float32)]
+        _, upd0 = c0.join(init=init)
+        _, upd1 = c1.join(init=init)
+        assert c0.active_transport == "shm" and c1.active_transport == "shm"
+
+        commits_each = 8
+        errs: list = []
+
+        def pump(client, upd, delta):
+            try:
+                u = upd
+                for _ in range(commits_each):
+                    res = client.commit([np.full(5, delta, np.float32)], u)
+                    assert res.applied
+                    _, u = client.pull()
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append(e)
+
+        t0 = threading.Thread(target=pump, args=(c0, upd0, 1.0))
+        t1 = threading.Thread(target=pump, args=(c1, upd1, 10.0))
+        t0.start()
+        t1.start()
+        t0.join()
+        t1.join()
+        assert not errs, errs
+        center, _ = c0.pull()
+        # Slot isolation: both streams folded exactly once each — any
+        # cross-client frame interleave would break this exact total.
+        np.testing.assert_allclose(
+            center[0], commits_each * 1.0 + commits_each * 10.0)
+        assert {wid for wid, _s, _t in srv.commit_log} == {0, 1}
+
+        # Kill ONLY c0's ring mid-flight: its next rpc rides the retry
+        # budget onto TCP; c1 stays on shm untouched.
+        for conn in c0._conns:
+            c0._disconnect(conn)
+        c0.shm_info = dict(c0.shm_info, uds="/nonexistent-dknetps.sock")
+        center0, _ = c0.pull()  # retried onto TCP inside the budget
+        assert c0.active_transport == "tcp"
+        np.testing.assert_allclose(center0[0], 88.0)
+        center1, _ = c1.pull()
+        assert c1.active_transport == "shm", \
+            "the sibling's ring must survive its neighbor's death"
+        np.testing.assert_allclose(center1[0], 88.0)
+    finally:
+        c0.close()
+        c1.close()
+        srv.close()
+
+
 def test_accept_attach_closes_fds_when_slot_ctor_raises(monkeypatch):
     """A Slot ctor failure (e.g. mmap ENOMEM under memory pressure) mid
     attach must close BOTH received fds — each failed attach would
