@@ -777,6 +777,90 @@ def _measure_spmd_transformer(name, *, num_layers, d_model, num_heads, d_ff,
     return rec
 
 
+def _measure_sharded_center(name, *, tensors=16, rows=256, cols=512,
+                            workers=4, commits=6, shard_counts=(1, 2, 4)):
+    """Config #10 — the sharded center plane's fold-throughput curve: the
+    SAME synthetic center (``tensors`` x ``rows`` x ``cols`` f32) committed
+    to by ``workers`` concurrent clients, measured against a single
+    :class:`PSServer` (shards=1, the baseline every point normalizes to)
+    and against :class:`ShardSet` gangs of 2 and 4 — each point the full
+    join/commit/pull protocol, sharded points through
+    :class:`ShardedPSClient`'s plan-scattered fan-out. ``speedup_vs_1`` at
+    4 shards is the acceptance number (the per-shard fold lock is the
+    single-PS bottleneck being split; docs/SHARDING.md)."""
+    import threading
+
+    from distkeras_tpu.netps.server import PSServer
+    from distkeras_tpu.netps.shards import ShardSet, make_ps_client
+
+    rng = np.random.default_rng(0)
+    center = [rng.standard_normal((rows, cols)).astype(np.float32)
+              for _ in range(tensors)]
+    center_bytes = sum(a.nbytes for a in center)
+    curve = []
+    for n in shard_counts:
+        if n == 1:
+            srv = PSServer(center=[a.copy() for a in center],
+                           discipline="adag").start()
+            endpoint, plan, closer = srv.endpoint, None, srv.close
+        else:
+            ss = ShardSet(n, center=[a.copy() for a in center],
+                          discipline="adag").start()
+            endpoint, plan, closer = ss.endpoint, ss.plan, ss.close
+        try:
+            barrier = threading.Barrier(workers + 1)
+            errors: list = []
+
+            def work(w, endpoint=endpoint, plan=plan, barrier=barrier,
+                     errors=errors):
+                client = make_ps_client(endpoint, plan=plan)
+                try:
+                    _c, counter = client.join(init=center)
+                    delta = [np.full_like(a, 1e-3) for a in center]
+                    barrier.wait()
+                    for _ in range(commits):
+                        client.commit(delta, counter)
+                        _c, counter = client.pull()
+                    client.leave()
+                except Exception as e:  # surfaced below, never swallowed
+                    errors.append(e)
+                finally:
+                    client.close()
+
+            threads = [threading.Thread(target=work, args=(w,))
+                       for w in range(workers)]
+            for t in threads:
+                t.start()
+            barrier.wait()  # joins (compile/plan adoption) stay untimed
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+        finally:
+            closer()
+        if errors:
+            raise errors[0]
+        folds = workers * commits
+        curve.append({
+            "shards": n,
+            "folds_per_sec": round(folds / dt, 2),
+            "bytes_per_sec": round(folds * center_bytes / dt, 1),
+        })
+    base = curve[0]["folds_per_sec"]
+    for pt in curve:
+        pt["speedup_vs_1"] = (round(pt["folds_per_sec"] / base, 3)
+                              if base > 0 else None)
+    best = curve[-1]
+    return {
+        "metric": f"{name}_folds_per_sec",
+        "value": best["folds_per_sec"], "unit": "folds/s",
+        "center_bytes": int(center_bytes),
+        "workers": workers,
+        "speedup_vs_single_ps": best["speedup_vs_1"],
+        "shard_curve": curve,
+    }
+
+
 def _measure_serving(name, *, feature_dim=64, hidden=256, num_classes=10,
                      qps_levels=(50, 200, 800), duration_s=2.0,
                      max_wait_ms=2.0, buckets="1,4,16,64",
@@ -1139,6 +1223,16 @@ def main():
                     dict(feature_dim=64, hidden=256, num_classes=10,
                          qps_levels=(50, 200, 800), duration_s=2.0)))
 
+    # 10 - the sharded center plane: fold throughput vs shard count over
+    # the SAME synthetic center (1 = plain PSServer baseline, 2/4 =
+    # ShardSet gangs dialed through ShardedPSClient). The curve pins how
+    # much of the single-PS fold-lock bottleneck the partition plan
+    # actually splits (acceptance: >= 1.6x at 4 shards on real hardware).
+    configs.append(("sharded_center", None, "sharded_center",
+                    dict(tensors=16, rows=256,
+                         cols=512 if on_tpu else 256,
+                         workers=4, commits=6 if on_tpu else 4)))
+
     # Optional subset for debugging: BENCH_CONFIGS=cifar10,resnet python bench.py
     only = [s for s in os.environ.get("BENCH_CONFIGS", "").split(",") if s]
     if only:
@@ -1164,6 +1258,8 @@ def main():
                         rec = _measure_netps_transformer(name, **kw)
                     elif discipline == "serving":
                         rec = _measure_serving(name, **kw)
+                    elif discipline == "sharded_center":
+                        rec = _measure_sharded_center(name, **kw)
                     else:
                         rec = _measure(name, model_fn, discipline, **kw)
                 break

@@ -40,8 +40,8 @@ from typing import Optional
 import numpy as np
 
 from distkeras_tpu.data.batching import BatchPlan
-from distkeras_tpu.netps.client import PSClient
 from distkeras_tpu.netps.fold import check_discipline
+from distkeras_tpu.netps.shards import make_ps_client
 from distkeras_tpu.resilience import faults as _faults
 
 
@@ -173,7 +173,8 @@ class ElasticTraining:
         self._closed = True
         if self._endpoint is not None and self._committed > 0:
             try:
-                with PSClient(self._endpoint, **self._client_kw) as obs:
+                with make_ps_client(self._endpoint,
+                                    **self._client_kw) as obs:
                     leaves, _updates = obs.pull()
                 self._final_params = self._unflatten(leaves)
             except Exception as e:  # noqa: BLE001 - surfaced via errors
@@ -249,7 +250,12 @@ class ElasticTraining:
         w = int(worker_id)
         suffix = telemetry.label_suffix()
         elastic = self.discipline in ("aeasgd", "eamsgd")
-        client = PSClient(self._endpoint, worker_id=w, **self._client_kw)
+        # Endpoint-shape agnostic: a sharded job endpoint (``;`` matrix)
+        # gets a ShardedPSClient; every worker rebuilds the identical plan
+        # from the same leaves + env rules, and the servers' hash check
+        # turns any drift into a typed error.
+        client = make_ps_client(self._endpoint, worker_id=w,
+                                **self._client_kw)
         try:
             center_leaves, counter = client.join(init=self._init_leaves)
             params = self._unflatten(center_leaves)

@@ -53,6 +53,15 @@ class Punchcard:
     when its lease lapses — the workers' ``DKTPU_PS_ENDPOINT`` then
     carries the comma-separated ``primary,standby`` list their hardened
     clients walk on failure.
+
+    Sharded center (``shards: N`` with N > 1): the job gets a GANG of N
+    shard servers instead of one — each launched ``--shard k/N`` with its
+    own pool-allocated port, per-shard state dir (``<state_dir>/shard-k``)
+    and, when ``standby_host`` is set, its own warm standby. The workers'
+    ``DKTPU_PS_ENDPOINT`` becomes the ``;``-separated shard x failover
+    matrix (``p0,s0;p1,s1;...``) their sharded clients dial; every shard's
+    durability/failover/supervision story is the single-PS one, N times.
+    See docs/SHARDING.md.
     """
 
     job_name: str
@@ -102,6 +111,13 @@ class Punchcard:
                 del self.ps["port"]
             if self.ps.get("standby_port") in allocated:
                 del self.ps["standby_port"]
+            for key in ("shard_ports", "standby_ports"):
+                ports = self.ps.get(key)
+                # Pool pins are only ever written as the whole list, so a
+                # fully-allocated list is ours to clear; an explicit list
+                # was never tracked and stays untouched.
+                if ports and all(p in allocated for p in ports):
+                    del self.ps[key]
 
     def resolved_coordinator_port(self) -> int:
         """The coordinator port, allocating (and pinning) one from the
@@ -111,6 +127,13 @@ class Punchcard:
             self.coordinator_port = self._reserve(self.hosts[0])
         return int(self.coordinator_port)
 
+    def ps_shard_count(self) -> int:
+        """How many center shards the card asks for (1 = the classic
+        single PS; the ``shards`` key is only meaningful with ``ps``)."""
+        if self.ps is None:
+            return 1
+        return max(1, int(self.ps.get("shards") or 1))
+
     def ps_endpoint(self) -> Optional[str]:
         """Endpoint(s) of the parameter server, None when ``ps`` unset:
         ``host:port``, or the ``primary,standby`` failover list when a
@@ -118,10 +141,46 @@ class Punchcard:
         ``port`` is allocated from the per-host pool (bind-probed, sticky
         — stored back into ``ps`` so the launch command, the workers'
         ``DKTPU_PS_ENDPOINT``, and every later call agree); the old fixed
-        7077 default broke the second job on a host."""
+        7077 default broke the second job on a host.
+
+        With ``shards: N`` (N > 1) this is the ``;``-separated shard x
+        failover MATRIX — ``p0,s0;p1,s1;...`` — each shard its own
+        pool-allocated port (pinned into ``shard_ports``, and
+        ``standby_ports`` when a ``standby_host`` is set): the exact
+        string a :class:`~distkeras_tpu.netps.shards.ShardedPSClient`
+        dials, one failover group per shard."""
         if self.ps is None:
             return None
         host = self.ps.get("host") or self.hosts[0]
+        n = self.ps_shard_count()
+        if n > 1:
+            ports = self.ps.get("shard_ports")
+            if ports is None:
+                ports = self.ps["shard_ports"] = [
+                    self._reserve(host) for _ in range(n)]
+            elif len(ports) != n:
+                raise ValueError(
+                    f"ps['shard_ports'] has {len(ports)} entries for "
+                    f"shards={n}")
+            standby_ports = None
+            if self.ps.get("standby_host"):
+                standby_ports = self.ps.get("standby_ports")
+                if standby_ports is None:
+                    standby_ports = self.ps["standby_ports"] = [
+                        self._reserve(self.ps["standby_host"])
+                        for _ in range(n)]
+                elif len(standby_ports) != n:
+                    raise ValueError(
+                        f"ps['standby_ports'] has {len(standby_ports)} "
+                        f"entries for shards={n}")
+            groups = []
+            for k in range(n):
+                group = f"{host}:{int(ports[k])}"
+                if standby_ports is not None:
+                    group += (f",{self.ps['standby_host']}:"
+                              f"{int(standby_ports[k])}")
+                groups.append(group)
+            return ";".join(groups)
         port = self.ps.get("port")
         if not port:
             port = self.ps["port"] = self._reserve(host)
@@ -133,8 +192,12 @@ class Punchcard:
         """``host:port`` of the warm standby, None when not configured.
         Like the primary's, a missing ``standby_port`` is pool-allocated
         and pinned (the old ``primary + 1`` rule collided as soon as a
-        second job's primary landed on that port)."""
+        second job's primary landed on that port). Sharded cards have no
+        single standby — their per-shard standbys live in the
+        :meth:`ps_endpoint` matrix — so this returns None for them."""
         if self.ps is None or not self.ps.get("standby_host"):
+            return None
+        if self.ps_shard_count() > 1:
             return None
         port = self.ps.get("standby_port")
         if not port:
@@ -170,6 +233,12 @@ class Job:
         self._ps_proc: Optional[subprocess.Popen] = None
         #: the warm-standby process (punchcards with a ``standby_host``).
         self._standby_proc: Optional[subprocess.Popen] = None
+        #: the shard-server gang (punchcards with ``shards: N``, N > 1) —
+        #: one primary per shard, and one standby per shard when a
+        #: ``standby_host`` is set. Unsharded cards keep using the two
+        #: attributes above.
+        self._shard_procs: list = []
+        self._shard_standby_procs: list = []
         #: restarts performed per host by :meth:`supervise`.
         self.restarts: list[int] = []
         #: PS-pair restarts performed by :meth:`supervise` (cold restarts
@@ -199,48 +268,86 @@ class Job:
             cmds.append(f"env {env_str} python {shlex.quote(pc.script)} {arg_str}".strip())
         return cmds
 
-    def render_ps_command(self) -> Optional[str]:
-        """The parameter-server launch line (None when ``ps`` is unset)."""
+    def render_ps_commands(self) -> list[str]:
+        """One launch line per shard server — a single-element list for the
+        classic unsharded card, N lines (each ``--shard k/N`` with its own
+        port and ``<state_dir>/shard-k``) for ``shards: N``; empty when
+        ``ps`` is unset."""
         pc = self.punchcard
         if pc.ps is None:
-            return None
-        # ps_endpoint() pins a pool-allocated port into ps["port"] when
-        # none was given, so the launch line and the workers' env agree.
+            return []
+        # ps_endpoint() pins the pool-allocated port(s) into the card, so
+        # the launch lines and the workers' env agree.
         pc.ps_endpoint()
-        port = int(pc.ps["port"])
-        cmd = (f"python -m distkeras_tpu.netps --host 0.0.0.0 "
-               f"--port {port} "
-               f"--discipline {shlex.quote(pc.ps.get('discipline', 'adag'))}")
-        if pc.ps.get("lease") is not None:
-            cmd += f" --lease {float(pc.ps['lease'])}"
-        if pc.ps.get("state_dir"):
-            cmd += f" --state-dir {shlex.quote(pc.ps['state_dir'])}"
-        if pc.ps.get("snapshot_every") is not None:
-            cmd += f" --snapshot-every {int(pc.ps['snapshot_every'])}"
-        return cmd
+        n = pc.ps_shard_count()
+        disc = shlex.quote(pc.ps.get("discipline", "adag"))
+        cmds = []
+        for k in range(n):
+            port = int(pc.ps["shard_ports"][k] if n > 1 else pc.ps["port"])
+            cmd = (f"python -m distkeras_tpu.netps --host 0.0.0.0 "
+                   f"--port {port} "
+                   f"--discipline {disc}")
+            if pc.ps.get("lease") is not None:
+                cmd += f" --lease {float(pc.ps['lease'])}"
+            if pc.ps.get("state_dir"):
+                state_dir = pc.ps["state_dir"]
+                if n > 1:
+                    state_dir = f"{state_dir}/shard-{k}"
+                cmd += f" --state-dir {shlex.quote(state_dir)}"
+            if pc.ps.get("snapshot_every") is not None:
+                cmd += f" --snapshot-every {int(pc.ps['snapshot_every'])}"
+            if n > 1:
+                cmd += f" --shard {k}/{n}"
+            cmds.append(cmd)
+        return cmds
+
+    def render_ps_command(self) -> Optional[str]:
+        """The parameter-server launch line (None when ``ps`` is unset) —
+        the first of :meth:`render_ps_commands`, which for the unsharded
+        card is the whole story."""
+        cmds = self.render_ps_commands()
+        return cmds[0] if cmds else None
+
+    def render_standby_commands(self) -> list[str]:
+        """One warm-standby launch line per shard (a single-element list
+        for the unsharded card; empty when no ``standby_host``). Each
+        standby journals into its own ``.standby``-suffixed directory
+        (``<state_dir>.standby``, or ``<state_dir>/shard-k.standby`` per
+        shard) so a promoted-then-restarted standby recovers
+        fenced-forward without ever sharing a directory with its
+        primary."""
+        pc = self.punchcard
+        if pc.ps is None or not pc.ps.get("standby_host"):
+            return []
+        n = pc.ps_shard_count()
+        disc = shlex.quote(pc.ps.get("discipline", "adag"))
+        groups = pc.ps_endpoint().split(";")
+        cmds = []
+        for k, group in enumerate(groups):
+            primary, standby = group.split(",", 1)
+            port = int(standby.rsplit(":", 1)[1])
+            cmd = (f"python -m distkeras_tpu.netps --host 0.0.0.0 "
+                   f"--port {port} --standby {shlex.quote(primary)} "
+                   f"--discipline {disc}")
+            if pc.ps.get("lease") is not None:
+                cmd += f" --lease {float(pc.ps['lease'])}"
+            if pc.ps.get("state_dir"):
+                state_dir = pc.ps["state_dir"]
+                state_dir = (f"{state_dir}/shard-{k}.standby" if n > 1
+                             else state_dir + ".standby")
+                cmd += f" --state-dir {shlex.quote(state_dir)}"
+            if pc.ps.get("snapshot_every") is not None:
+                cmd += f" --snapshot-every {int(pc.ps['snapshot_every'])}"
+            if n > 1:
+                cmd += f" --shard {k}/{n}"
+            cmds.append(cmd)
+        return cmds
 
     def render_standby_command(self) -> Optional[str]:
-        """The warm-standby launch line (None when no standby configured).
-        The standby journals into ``<state_dir>.standby`` so a promoted-
-        then-restarted standby recovers fenced-forward without ever
-        sharing a directory with the primary."""
-        pc = self.punchcard
-        standby = pc.ps_standby_endpoint()
-        if standby is None:
-            return None
-        primary = pc.ps_endpoint().split(",", 1)[0]
-        port = int(standby.rsplit(":", 1)[1])
-        cmd = (f"python -m distkeras_tpu.netps --host 0.0.0.0 "
-               f"--port {port} --standby {shlex.quote(primary)} "
-               f"--discipline {shlex.quote(pc.ps.get('discipline', 'adag'))}")
-        if pc.ps.get("lease") is not None:
-            cmd += f" --lease {float(pc.ps['lease'])}"
-        if pc.ps.get("state_dir"):
-            cmd += (" --state-dir "
-                    + shlex.quote(pc.ps["state_dir"] + ".standby"))
-        if pc.ps.get("snapshot_every") is not None:
-            cmd += f" --snapshot-every {int(pc.ps['snapshot_every'])}"
-        return cmd
+        """The warm-standby launch line (None when no standby configured)
+        — the first of :meth:`render_standby_commands`."""
+        cmds = self.render_standby_commands()
+        return cmds[0] if cmds else None
 
     def _labels(self) -> dict:
         """Attribution fields for supervision telemetry events: the
@@ -278,15 +385,27 @@ class Job:
         cmds = self.render_commands()
         if dry_run:
             return cmds
-        ps_cmd = self.render_ps_command()
-        if ps_cmd is not None and self._ps_proc is None:
-            ps_host = (self.punchcard.ps.get("host")
-                       or self.punchcard.hosts[0])
-            self._ps_proc = self._spawn_cmd(ps_host, ps_cmd)
-        standby_cmd = self.render_standby_command()
-        if standby_cmd is not None and self._standby_proc is None:
-            self._standby_proc = self._spawn_cmd(
-                self.punchcard.ps["standby_host"], standby_cmd)
+        pc = self.punchcard
+        if pc.ps is not None and pc.ps_shard_count() > 1:
+            # The shard gang: N primaries (and N standbys when configured)
+            # launched before the workers, exactly like the single PS.
+            ps_host = pc.ps.get("host") or pc.hosts[0]
+            if not self._shard_procs:
+                self._shard_procs = [self._spawn_cmd(ps_host, c)
+                                     for c in self.render_ps_commands()]
+            if not self._shard_standby_procs:
+                self._shard_standby_procs = [
+                    self._spawn_cmd(pc.ps["standby_host"], c)
+                    for c in self.render_standby_commands()]
+        else:
+            ps_cmd = self.render_ps_command()
+            if ps_cmd is not None and self._ps_proc is None:
+                ps_host = pc.ps.get("host") or pc.hosts[0]
+                self._ps_proc = self._spawn_cmd(ps_host, ps_cmd)
+            standby_cmd = self.render_standby_command()
+            if standby_cmd is not None and self._standby_proc is None:
+                self._standby_proc = self._spawn_cmd(
+                    pc.ps["standby_host"], standby_cmd)
         self._cmds = cmds
         self.restarts = [0] * len(cmds)
         for i in range(len(cmds)):
@@ -315,10 +434,16 @@ class Job:
         self.punchcard.release_ports()
         return rcs
 
+    def _all_ps_procs(self) -> list:
+        """Every PS-plane process handle this job holds — the unsharded
+        pair plus the shard gang (Nones included; callers skip them)."""
+        return ([self._ps_proc, self._standby_proc]
+                + list(self._shard_procs) + list(self._shard_standby_procs))
+
     def _stop_ps(self, grace: float = 5.0) -> None:
-        """Drain the parameter-server pair once the workers are done:
+        """Drain the parameter-server plane once the workers are done:
         SIGTERM triggers the graceful drain; SIGKILL only if it won't."""
-        for p in (self._ps_proc, self._standby_proc):
+        for p in self._all_ps_procs():
             if p is None or p.poll() is not None:
                 continue
             try:
@@ -420,13 +545,8 @@ class Job:
         burn its whole budget in one polling second)."""
         from distkeras_tpu import telemetry
 
-        for attr, role, cmd_fn, host in (
-                ("_ps_proc", "primary", self.render_ps_command,
-                 (self.punchcard.ps or {}).get("host")
-                 or self.punchcard.hosts[0]),
-                ("_standby_proc", "standby", self.render_standby_command,
-                 (self.punchcard.ps or {}).get("standby_host"))):
-            p = getattr(self, attr)
+        for role, get, put, cmd_fn, host in self._ps_plane():
+            p = get()
             # rc 0 is a deliberate drain (operator SIGTERM), not a crash —
             # same exemption the worker-restart policy applies.
             if p is None or p.poll() is None or p.returncode == 0:
@@ -442,7 +562,46 @@ class Job:
                 **self._labels(),
                 "role": role, "exit_code": p.returncode,
                 "restart": self.ps_restarts})
-            setattr(self, attr, self._spawn_cmd(host, cmd_fn()))
+            put(self._spawn_cmd(host, cmd_fn()))
+
+    def _ps_plane(self) -> list:
+        """The PS-plane roster ``(role, get, put, cmd_fn, host)`` that
+        :meth:`_revive_ps` walks — the primary/standby pair for the
+        unsharded card, or one entry per shard primary AND per shard
+        standby for ``shards: N`` (roles ``shard-k`` / ``shard-k-standby``,
+        so every shard gets its own restart budget and a flapping shard
+        cannot drain its siblings')."""
+        pc = self.punchcard
+        ps = pc.ps or {}
+        ps_host = ps.get("host") or pc.hosts[0]
+        if pc.ps is not None and pc.ps_shard_count() > 1:
+            entries = []
+            for k in range(len(self._shard_procs)):
+                entries.append((
+                    f"shard-{k}",
+                    lambda k=k: self._shard_procs[k],
+                    lambda p, k=k: self._shard_procs.__setitem__(k, p),
+                    lambda k=k: self.render_ps_commands()[k],
+                    ps_host))
+            for k in range(len(self._shard_standby_procs)):
+                entries.append((
+                    f"shard-{k}-standby",
+                    lambda k=k: self._shard_standby_procs[k],
+                    lambda p, k=k: self._shard_standby_procs.__setitem__(
+                        k, p),
+                    lambda k=k: self.render_standby_commands()[k],
+                    ps["standby_host"]))
+            return entries
+        return [
+            ("primary",
+             lambda: self._ps_proc,
+             lambda p: setattr(self, "_ps_proc", p),
+             self.render_ps_command, ps_host),
+            ("standby",
+             lambda: self._standby_proc,
+             lambda p: setattr(self, "_standby_proc", p),
+             self.render_standby_command, ps.get("standby_host")),
+        ]
 
     def kill(self, grace: float = 5.0) -> None:
         """Tear down every launched process that is still running:
@@ -453,7 +612,7 @@ class Job:
         unreapable (D-state) process is abandoned rather than hanging the
         caller."""
         live = [p for p in self._procs if p.poll() is None]
-        for ps in (self._ps_proc, self._standby_proc):
+        for ps in self._all_ps_procs():
             if ps is not None and ps.poll() is None:
                 live.append(ps)
         for p in live:

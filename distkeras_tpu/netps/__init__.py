@@ -47,7 +47,18 @@ production edges the reference never had:
   primary's lease lapses, and fences the old epoch — stale-lineage
   commits answer a typed ``EpochFencedError``, never a fold; clients
   walk a comma-separated ``DKTPU_PS_ENDPOINT`` list to the promoted
-  primary and reconcile seq state on re-join.
+  primary and reconcile seq state on re-join;
+* :mod:`~distkeras_tpu.netps.endpoints` — the shared failover mechanics
+  (split order, CAS walk, promotion patience window) every wire client
+  rides: PSClient, the serving frontend, and the sharded fan-out;
+* :mod:`~distkeras_tpu.netps.shards` — the sharded center plane: a
+  :class:`PartitionPlan` (regex rules + byte-balanced default, budgeting
+  optimizer state, row-splitting oversized tensors) assigns every tensor
+  slice to one of N shard servers — each a full PSServer with its own
+  journal lineage, warm standby, and epoch fence — and a
+  :class:`ShardedPSClient` fans pulls/commits out under ONE logical seq,
+  plan-hash-validated at join and on every pull (mismatch = typed
+  :class:`ShardPlanError`, never a silent mis-fold). docs/SHARDING.md.
 
 The data plane (compute/comms overlap, compressed deltas, sharded
 striping over ``DKTPU_NET_SHARDS`` connections, zero-copy frames) is
@@ -71,6 +82,7 @@ from distkeras_tpu.netps.errors import (  # noqa: F401
     RPCTimeoutError,
     ServerClosedError,
     ServerDrainingError,
+    ShardPlanError,
 )
 from distkeras_tpu.netps.fold import (  # noqa: F401
     SUPPORTED_DISCIPLINES,
@@ -79,13 +91,20 @@ from distkeras_tpu.netps.fold import (  # noqa: F401
 )
 from distkeras_tpu.netps.hier import AggregatorServer  # noqa: F401
 from distkeras_tpu.netps.server import PSServer, serve  # noqa: F401
+from distkeras_tpu.netps.shards import (  # noqa: F401
+    PartitionPlan,
+    ShardedPSClient,
+    ShardSet,
+    make_ps_client,
+)
 from distkeras_tpu.netps.standby import StandbyServer  # noqa: F401
 
 __all__ = [
     "PSServer", "serve", "PSClient", "CommitResult", "ChaosProxy",
     "AggregatorServer", "StandbyServer",
+    "PartitionPlan", "ShardedPSClient", "ShardSet", "make_ps_client",
     "NetPSError", "ProtocolError", "RPCTimeoutError", "ServerDrainingError",
     "LeaseExpiredError", "ServerClosedError", "EpochFencedError",
-    "NotPrimaryError",
+    "NotPrimaryError", "ShardPlanError",
     "SUPPORTED_DISCIPLINES", "commit_scale", "fold_delta",
 ]

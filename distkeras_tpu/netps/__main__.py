@@ -65,14 +65,29 @@ def main(argv=None) -> int:
     ap.add_argument("--promote-after", type=float, default=None,
                     help="seconds of primary silence before a standby "
                          "promotes itself (default: the lease)")
+    ap.add_argument("--shard", metavar="K/N", default=None,
+                    help="serve shard K of an N-shard center (0-based); "
+                         "the partition plan is adopted from the first "
+                         "join (and persisted under --state-dir). Applies "
+                         "to primaries and standbys alike.")
     args = ap.parse_args(argv)
+    shard_index = shard_count = None
+    if args.shard:
+        try:
+            k, n = args.shard.split("/", 1)
+            shard_index, shard_count = int(k), int(n)
+        except ValueError:
+            ap.error(f"--shard must be K/N (got {args.shard!r})")
+        if not 0 <= shard_index < shard_count:
+            ap.error(f"--shard {args.shard}: K must be in 0..N-1")
     state_dir = (args.state_dir if args.state_dir is not None
                  else config.env_str("DKTPU_PS_STATE_DIR") or None)
     standby_of = (args.standby if args.standby is not None
                   else config.env_str("DKTPU_PS_STANDBY") or None)
     kw = dict(discipline=args.discipline, host=args.host, port=args.port,
               lease_s=args.lease, state_dir=state_dir,
-              snapshot_every=args.snapshot_every)
+              snapshot_every=args.snapshot_every,
+              shard_index=shard_index, shard_count=shard_count)
     if standby_of:
         from distkeras_tpu.netps.standby import StandbyServer
 

@@ -62,6 +62,7 @@ from typing import NamedTuple, Optional, Sequence
 import numpy as np
 
 from distkeras_tpu.netps import shm, wire
+from distkeras_tpu.netps.endpoints import EndpointWalker, budget_left
 from distkeras_tpu.netps.errors import (
     EpochFencedError,
     LeaseExpiredError,
@@ -71,6 +72,7 @@ from distkeras_tpu.netps.errors import (
     RPCTimeoutError,
     ServerClosedError,
     ServerDrainingError,
+    ShardPlanError,
 )
 from distkeras_tpu.resilience.backoff import full_jitter
 from distkeras_tpu.runtime import config
@@ -90,6 +92,7 @@ _ERROR_TYPES = {
     "protocol": ProtocolError,
     "epoch_fenced": EpochFencedError,
     "not_primary": NotPrimaryError,
+    "shard_plan": ShardPlanError,
 }
 
 #: striped-pull consistency budget: whole-pull re-reads before falling back
@@ -144,12 +147,18 @@ class PSClient:
                  shards: Optional[int] = None,
                  compress: Optional[str] = None,
                  transport: Optional[str] = None):
-        #: ordered (host, port) failover list — ``endpoint`` may be the
+        #: serializes the shm->TCP fallback sweep AND the endpoint walk:
+        #: only the stripe thread that actually transitions (walks, or
+        #: nulls shm_info) closes the other conns — a second sweeper would
+        #: otherwise close a sibling's freshly re-established TCP socket
+        #: mid-RPC. Created first so the walker can share it.
+        self._fallback_lock = threading.Lock()
+        #: ordered failover traversal — ``endpoint`` may be the
         #: comma-separated ``DKTPU_PS_ENDPOINT`` form (primary first, then
         #: standbys); a single endpoint is a one-element list and behaves
-        #: exactly as before.
-        self._endpoints = wire.split_endpoints(endpoint)
-        self._ep_idx = 0
+        #: exactly as before. Shares the fallback lock: the walk teardown
+        #: must not interleave with the shm fallback sweep.
+        self._walker = EndpointWalker(endpoint, lock=self._fallback_lock)
         self.endpoint = endpoint
         self.worker_id = worker_id
         self.timeout = float(timeout if timeout is not None
@@ -182,11 +191,6 @@ class PSClient:
         #: the server's advertised ring endpoint when the same-host check
         #: passed (``{"boot_id", "uds"}``), else None (TCP dialect).
         self.shm_info: Optional[dict] = None
-        #: serializes the shm->TCP fallback sweep: only the stripe thread
-        #: that actually transitions shm_info to None closes the other
-        #: conns — a second sweeper would otherwise close a sibling's
-        #: freshly re-established TCP socket mid-RPC.
-        self._fallback_lock = threading.Lock()
         self.lease_s: Optional[float] = None
         #: the primary epoch the last join adopted (None until a join
         #: against an epoch-aware server); rides in every pull/commit/
@@ -205,6 +209,17 @@ class PSClient:
         #: times this client re-joined after an eviction (worker loops
         #: watch it to re-adopt the center on rejoin).
         self.rejoin_count = 0
+        #: extra header fields merged into EVERY join (including the
+        #: auto-rejoin after an eviction/fence — an attribute, not a join()
+        #: parameter, precisely so rejoins keep carrying it). The sharded
+        #: client rides its shard identity + plan hash here.
+        self._join_extra: dict = {}
+        #: the last join reply's ``caps`` (the server's full capability
+        #: advertisement, including any ``sharding`` identity) and the last
+        #: ``plan_hash`` any reply echoed — the sharded client's
+        #: cross-check surface.
+        self.peer_caps: Optional[dict] = None
+        self.peer_plan_hash: Optional[str] = None
 
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:
@@ -247,27 +262,37 @@ class PSClient:
         """The dialect the data connections speak right now."""
         return "shm" if self.shm_info is not None else "tcp"
 
+    @property
+    def _endpoints(self) -> list:
+        """Ordered (host, port) failover list (compat alias onto the
+        shared :class:`EndpointWalker`)."""
+        return self._walker.endpoints
+
+    @property
+    def _ep_idx(self) -> int:
+        return self._walker.index
+
     def _current_endpoint(self) -> tuple[str, int]:
-        return self._endpoints[self._ep_idx % len(self._endpoints)]
+        return self._walker.current()
 
     def _walk_endpoints(self, seen_idx: int) -> None:
         """Advance to the next endpoint after a failure observed against
-        ``seen_idx`` (CAS'd under the fallback lock so N stripe threads
-        failing together advance ONE step, not N). Walking drops every
-        connection and any ring attachment — the next endpoint is a
-        different process; nothing negotiated with the old one survives."""
-        if len(self._endpoints) <= 1:
-            return
+        ``seen_idx`` (the walker's CAS, under the shared fallback lock, so
+        N stripe threads failing together advance ONE step, not N).
+        Walking drops every connection and any ring attachment — the next
+        endpoint is a different process; nothing negotiated with the old
+        one survives."""
         from distkeras_tpu import telemetry
 
-        with self._fallback_lock:
-            walked = self._ep_idx == seen_idx
-            if walked:
-                self._ep_idx = (seen_idx + 1) % len(self._endpoints)
-                self.shm_info = None
-                for conn in self._conns:
-                    self._disconnect(conn)
-        if walked:
+        def teardown():
+            # Runs under _fallback_lock: the walker wraps on_walk in its
+            # shared lock, which IS that lock (see __init__) — the
+            # analyzer can't see through the callback indirection.
+            self.shm_info = None  # dk: disable=DK202
+            for conn in self._conns:
+                self._disconnect(conn)
+
+        if self._walker.walk(seen_idx, on_walk=teardown):
             telemetry.counter("netps.endpoint_walks").add(1)
 
     @staticmethod
@@ -340,13 +365,7 @@ class PSClient:
         # promotion) + one deadline has elapsed, however many attempts
         # that takes. Single-endpoint clients keep the strict budget —
         # nothing is coming to save them, failing fast is correct.
-        patience = None
-        if len(self._endpoints) > 1:
-            lease = self.lease_s
-            if not lease:
-                lease = config.env_float("DKTPU_PS_LEASE")
-            patience = (time.monotonic() + 2.0 * float(lease or 0.0)
-                        + self.timeout)
+        patience = self._walker.patience(self.lease_s, self.timeout)
         last_exc: Optional[BaseException] = None
         attempt = 0
         while True:
@@ -440,10 +459,9 @@ class PSClient:
     def _budget_left(attempt: int, attempts: int,
                      patience: Optional[float]) -> bool:
         """May the retry loop go around again? The attempt budget, OR —
-        multi-endpoint only — the failover patience window."""
-        if attempt + 1 < attempts:
-            return True
-        return patience is not None and time.monotonic() < patience
+        multi-endpoint only — the failover patience window (the shared
+        :func:`distkeras_tpu.netps.endpoints.budget_left`)."""
+        return budget_left(attempt, attempts, patience)
 
     def _attempt(self, conn: _Conn, req: int, hdr: dict,
                  arrays: Sequence) -> tuple[dict, list]:
@@ -565,8 +583,11 @@ class PSClient:
         ``init`` seeds an uninitialized server (first joiner wins; later
         inits are ignored — everyone adopts the server's center). The
         join reply's advertised capabilities select the wire dialect
-        (codec + striping) for every later pull/commit."""
-        hdr, center = self._rpc("join", {"caps": wire.CAPS},
+        (codec + striping) for every later pull/commit. ``_join_extra``
+        fields (the sharded client's shard identity + plan) ride on every
+        join, auto-rejoins included."""
+        hdr, center = self._rpc("join",
+                                dict(self._join_extra, caps=wire.CAPS),
                                 list(init or ()))
         self.worker_id = int(hdr["worker_id"])
         self.lease_s = hdr.get("lease_s")
@@ -576,6 +597,10 @@ class PSClient:
         self.epoch = (int(hdr["epoch"]) if hdr.get("epoch") is not None
                       else None)
         caps = hdr.get("caps") or {}
+        self.peer_caps = caps
+        sharding = caps.get("sharding")
+        self.peer_plan_hash = (sharding.get("plan_hash")
+                               if isinstance(sharding, dict) else None)
         self.codec = (self.requested_codec
                       if self.requested_codec in caps.get("codecs", ())
                       else wire.CODEC_NONE)
@@ -640,6 +665,10 @@ class PSClient:
                 raise
             self.rejoin_count += 1
             return self.join()
+        if hdr.get("plan_hash") is not None:
+            # A shard server re-proves its plan identity on every pull;
+            # keep the latest so the sharded client can cross-check.
+            self.peer_plan_hash = hdr["plan_hash"]
         return center, int(hdr["updates"])
 
     def _striped_pull(self) -> tuple[list, int]:
@@ -692,14 +721,21 @@ class PSClient:
             items.append((encoded, extras) if extras else encoded)
         return items
 
-    def commit(self, delta: Sequence[np.ndarray],
-               pulled_counter: int) -> CommitResult:
+    def commit(self, delta: Sequence[np.ndarray], pulled_counter: int,
+               seq: Optional[int] = None) -> CommitResult:
         """Fold ``delta`` (worker-normalized) into the center. The seq is
         assigned before the first transmission and reused across retries:
         a lost ACK can never double-fold. With striping, ONE seq spans all
-        stripe sub-RPCs — the server assembles them and folds once."""
-        self._seq += 1
-        seq = self._seq
+        stripe sub-RPCs — the server assembles them and folds once. An
+        explicit ``seq`` is the sharded client's one-logical-seq fan-out
+        (and its dedup-safe same-seq retransmit after a per-shard
+        eviction); this client's own counter only ever moves forward."""
+        if seq is None:
+            self._seq += 1
+            seq = self._seq
+        else:
+            self._seq = max(self._seq, int(seq))
+            seq = int(seq)
         items = self._compress_delta(delta)
         base = self._stamped({"seq": seq, "pulled": int(pulled_counter)})
         try:

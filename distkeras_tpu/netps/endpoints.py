@@ -1,0 +1,106 @@
+"""Shared endpoint-walk/failover mechanics for every netps-wire client.
+
+Before this module, :class:`~distkeras_tpu.netps.client.PSClient` and the
+serving plane's ``ServeClient`` each carried their own copy of the same
+three ideas — split a comma-separated failover list, advance through it in
+order on failure, and (for lease-granting servers) keep retrying until the
+promotion window has genuinely elapsed. The sharded center plane adds a
+third client that needs all three, so they live here once:
+
+* **split** — :func:`distkeras_tpu.netps.wire.split_endpoints` order:
+  primary first, then standbys in promotion-preference order;
+* **walk order** — :meth:`EndpointWalker.walk` is a CAS advance (N stripe
+  threads failing together move ONE step, not N); :meth:`EndpointWalker.
+  advance` is the unconditional single-threaded-loop form ``ServeClient``
+  uses. Both run the caller's teardown callback under the walker's lock so
+  connection state can never straddle two endpoints;
+* **patience window** — :meth:`EndpointWalker.patience`: with standbys
+  configured the retry budget must bridge lease lapse + promotion (~2x
+  the lease) plus one RPC deadline, however many attempts that takes;
+  :func:`budget_left` is the loop guard that honors it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from distkeras_tpu.netps import wire
+from distkeras_tpu.runtime import config
+
+
+class EndpointWalker:
+    """Ordered failover traversal of a ``"host:port[,host:port...]"``
+    endpoint list. ``lock`` lets a caller share its own serialization
+    domain (PSClient's fallback lock also guards the shm sweep, and the
+    walk teardown must not interleave with it); by default the walker owns
+    a private lock."""
+
+    def __init__(self, endpoint: str,
+                 lock: Optional[threading.Lock] = None):
+        #: ordered (host, port) list — primary first, then standbys.
+        self.endpoints = wire.split_endpoints(endpoint)
+        self._idx = 0
+        self._lock = lock if lock is not None else threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self.endpoints)
+
+    @property
+    def index(self) -> int:
+        """The current position (monotonic under :meth:`advance`; callers
+        snapshot it as the ``seen_idx`` a later :meth:`walk` CASes on)."""
+        return self._idx
+
+    def current(self) -> tuple:
+        return self.endpoints[self._idx % len(self.endpoints)]
+
+    def walk(self, seen_idx: int,
+             on_walk: Optional[Callable[[], None]] = None) -> bool:
+        """CAS advance past a failure observed against ``seen_idx``: of N
+        threads failing together exactly one wins and moves ONE step (the
+        rest observe the already-moved index and do nothing). The winner's
+        ``on_walk`` teardown runs under the lock — the next endpoint is a
+        different process, so nothing negotiated with the old one may
+        survive into a sibling's concurrent attempt. Single-endpoint
+        walkers never walk (nothing is coming to save them). Returns
+        whether THIS call advanced."""
+        if len(self.endpoints) <= 1:
+            return False
+        with self._lock:
+            walked = self._idx == seen_idx
+            if walked:
+                self._idx = (seen_idx + 1) % len(self.endpoints)
+                if on_walk is not None:
+                    on_walk()
+        return walked
+
+    def advance(self, on_walk: Optional[Callable[[], None]] = None) -> None:
+        """Unconditional advance — the single-threaded client form (one
+        request in flight, every failure is ours). Teardown under the lock,
+        same as :meth:`walk`."""
+        with self._lock:
+            self._idx += 1
+            if on_walk is not None:
+                on_walk()
+
+    def patience(self, lease_s: Optional[float],
+                 timeout: float) -> Optional[float]:
+        """Monotonic deadline a multi-endpoint retry loop keeps walking
+        until: 2x the lease (failure detection + standby promotion) plus
+        one RPC deadline. ``None`` for a single endpoint — the strict
+        attempt budget applies, failing fast is correct."""
+        if len(self.endpoints) <= 1:
+            return None
+        lease = lease_s if lease_s else config.env_float("DKTPU_PS_LEASE")
+        return time.monotonic() + 2.0 * float(lease or 0.0) + float(timeout)
+
+
+def budget_left(attempt: int, attempts: int,
+                patience: Optional[float]) -> bool:
+    """May the retry loop go around again? The attempt budget, OR — when a
+    patience window is set (multi-endpoint) — wall-clock inside it."""
+    if attempt + 1 < attempts:
+        return True
+    return patience is not None and time.monotonic() < patience

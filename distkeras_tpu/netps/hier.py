@@ -47,11 +47,21 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from distkeras_tpu.netps.client import PSClient
 from distkeras_tpu.netps.errors import NetPSError
 from distkeras_tpu.netps.fold import check_discipline, decode_entry
 from distkeras_tpu.netps.server import PSServer
+from distkeras_tpu.netps.shards import make_ps_client
 from distkeras_tpu.runtime import config
+
+
+def _counter_scalar(updates) -> int:
+    """A sharded root's pull/join returns one counter PER SHARD; the
+    aggregator mirrors a single root-lineage counter locally, so take the
+    MIN — staleness charged from it can only be overstated (DynSGD then
+    downweights, which is safe), never negative."""
+    if isinstance(updates, (tuple, list)):
+        return min(int(u) for u in updates)
+    return int(updates)
 
 #: default seconds an under-fan-in accumulation may age before it is
 #: flushed anyway (a straggler must not hold the whole host's progress).
@@ -81,10 +91,14 @@ class AggregatorServer(PSServer):
         # must not leak a phantom root membership); the PSClient ctor
         # validates the transport.
         check_discipline(discipline)
-        self._up = PSClient(upstream, timeout=timeout, retries=retries,
-                            backoff=backoff, transport=transport)
+        # The factory: a sharded root (``;`` endpoint matrix) gets a
+        # ShardedPSClient — the aggregator is then the ONE sharding-aware
+        # hop on this host, and its local workers stay plain.
+        self._up = make_ps_client(upstream, timeout=timeout, retries=retries,
+                                  backoff=backoff, transport=transport)
         try:
             center, updates = self._up.join(init=list(init or ()))
+            updates = _counter_scalar(updates)
             super().__init__(center=center, discipline=discipline,
                              host=host, port=port, lease_s=lease_s,
                              transport=transport)
@@ -241,7 +255,7 @@ class AggregatorServer(PSServer):
             return True  # commit already accounted; re-sync next flush
         with self._lock:
             self._center = [np.asarray(a, np.float32) for a in center]
-            self._updates = int(updates)
+            self._updates = _counter_scalar(updates)
         return True
 
     def _flusher_loop(self) -> None:

@@ -56,8 +56,11 @@ from typing import Any, Optional
 import numpy as np
 
 from distkeras_tpu.data.batching import BatchPlan, apply_round_transform
-from distkeras_tpu.netps.client import CommitResult, PSClient
+from distkeras_tpu.netps import wire
+from distkeras_tpu.netps.client import CommitResult
 from distkeras_tpu.netps.fold import check_discipline
+from distkeras_tpu.netps.shards import (is_sharded_endpoint, make_ps_client,
+                                        plan_for_model)
 from distkeras_tpu.resilience import faults as _faults
 from distkeras_tpu.runtime import config
 
@@ -66,6 +69,41 @@ def _leaves(tree) -> list:
     import jax
 
     return [np.asarray(a, np.float32) for a in jax.tree.leaves(tree)]
+
+
+def _leaf_names(tree) -> list:
+    """Stable parameter names for partition rules: the pytree key path of
+    each leaf, "/"-joined (``params/dense/kernel``-style for Flax trees)."""
+    import jax
+
+    def part(k):
+        for attr in ("key", "name", "idx"):
+            if hasattr(k, attr):
+                return str(getattr(k, attr))
+        return str(k).strip("[].'\"")
+
+    paths, _ = jax.tree_util.tree_flatten_with_path(tree)
+    names = ["/".join(part(k) for k in path) or f"param_{i:04d}"
+             for i, (path, _leaf) in enumerate(paths)]
+    # Key paths are unique by construction, but a defensive fallback keeps
+    # the plan's name->tensor contract total even for exotic pytrees.
+    if len(set(names)) != len(names):
+        names = [f"{n}#{i}" for i, n in enumerate(names)]
+    return names
+
+
+def _measured_opt_factor(tx, params) -> float:
+    """Optimizer-state bytes per parameter byte, measured from the actual
+    transform state (adagrad accumulators ~= 1.0; chained transforms more).
+    This is what makes the shard plan budget center + OPTIMIZER memory —
+    the per-shard cap is honest about what the shard really holds."""
+    import jax
+
+    center = sum(a.nbytes for a in _leaves(params))
+    if center <= 0:
+        return 0.0
+    opt = sum(np.asarray(a).nbytes for a in jax.tree.leaves(tx.init(params)))
+    return float(opt) / float(center)
 
 
 def _worker_round(plan: BatchPlan, r: int, w: int):
@@ -211,6 +249,22 @@ def run_remote(
     meter = _CommsMeter()
     client_kw = dict(timeout=timeout, retries=retries, backoff=backoff,
                      shards=shards, compress=compress, transport=transport)
+    shard_plan = None
+    if is_sharded_endpoint(endpoint):
+        # Sharded center plane: build THE partition plan once, here, from
+        # the model's leaves (names = pytree key paths, so env rules can
+        # pin by layer) and the MEASURED optimizer-state factor — every
+        # worker client carries it, and the servers hash-validate it at
+        # join so plan drift is a typed error, never a silent mis-fold.
+        shard_plan = plan_for_model(
+            init_leaves, len(wire.split_shard_endpoints(endpoint)),
+            names=_leaf_names(model.params),
+            opt_factor=_measured_opt_factor(tx, model.params))
+        telemetry.event("netps_shard_plan", {
+            "shards": shard_plan.num_shards,
+            "hash": shard_plan.plan_hash[:12],
+            "skew": round(shard_plan.skew(), 4)})
+        client_kw["plan"] = shard_plan
     hier = (config.env_bool("DKTPU_NET_HIER") if hier is None else bool(hier))
     agg = None
     worker_endpoint = endpoint
@@ -230,8 +284,12 @@ def run_remote(
         return jax.tree.unflatten(treedef, [np.asarray(a) for a in leaves])
 
     def work(w: int) -> None:
-        client = PSClient(worker_endpoint, worker_id=w, **client_kw)
-        pull_client: Optional[PSClient] = None
+        # The factory: a ShardedPSClient when worker_endpoint is a shard
+        # matrix, a plain PSClient otherwise (the hier path always hands
+        # workers the aggregator's plain endpoint — the aggregator's own
+        # upstream client is the sharded one).
+        client = make_ps_client(worker_endpoint, worker_id=w, **client_kw)
+        pull_client = None
         commit_lane = pull_lane = None
         if inflight > 1:
             # Two comms lanes per worker: an ORDERED commit lane (seq order
@@ -245,9 +303,9 @@ def run_remote(
         try:
             center_leaves, counter = client.join(init=init_leaves)
             if inflight > 1:
-                pull_client = PSClient(worker_endpoint,
-                                       worker_id=client.worker_id,
-                                       **client_kw)
+                pull_client = make_ps_client(worker_endpoint,
+                                             worker_id=client.worker_id,
+                                             **client_kw)
                 # Striping/codec/transport state without a join: adopt the
                 # negotiated dialect (membership is by worker_id, not by
                 # connection).
@@ -390,7 +448,8 @@ def run_remote(
         meter.export()
     if errors:
         raise errors[0]
-    with PSClient(endpoint, timeout=timeout, retries=retries,
-                  backoff=backoff, transport=transport) as observer:
+    with make_ps_client(endpoint, plan=shard_plan, timeout=timeout,
+                        retries=retries, backoff=backoff,
+                        transport=transport) as observer:
         final_leaves, _updates = observer.pull()
     return unflatten(final_leaves), losses

@@ -40,6 +40,7 @@ segments. Same handlers, same dispatch, same guarantees.
 from __future__ import annotations
 
 import collections
+import json
 import os
 import signal
 import socket
@@ -95,8 +96,28 @@ class PSServer:
                  snapshot_every: Optional[int] = None,
                  epoch: int = 0,
                  commit_log_keep: Optional[int] = None,
-                 standby: bool = False):
+                 standby: bool = False,
+                 shard_index: Optional[int] = None,
+                 shard_count: Optional[int] = None,
+                 shard_plan=None):
         self.discipline = check_discipline(discipline)
+        #: sharded-center identity: which slice of which PartitionPlan this
+        #: server holds. ``None`` index means a plain (whole-center) server.
+        #: The plan itself may arrive later — a shard launched empty adopts
+        #: it from the first join and persists it next to the journal.
+        self.shard_index = None if shard_index is None else int(shard_index)
+        self.shard_count = (int(shard_count) if shard_count is not None
+                            else (None if self.shard_index is None else 1))
+        if self.shard_index is not None and not (
+                0 <= self.shard_index < self.shard_count):
+            raise ValueError(f"shard index {self.shard_index} outside "
+                             f"0..{self.shard_count - 1}")
+        self.shard_plan = None
+        if shard_plan is not None:
+            from distkeras_tpu.netps.shards import plan as _plan_mod
+            self.shard_plan = (shard_plan if isinstance(
+                shard_plan, _plan_mod.PartitionPlan)
+                else _plan_mod.PartitionPlan.from_dict(shard_plan))
         self.transport = (transport if transport is not None
                           else shm.transport_mode())
         if self.transport not in shm.TRANSPORTS:
@@ -187,6 +208,22 @@ class PSServer:
                 # Ctor-seeded center with a fresh dir: anchor the journal
                 # with the base snapshot a recovery will replay onto.
                 self._snapshot_locked()
+        #: durable plan identity: a restarted shard must refuse a client
+        #: whose plan drifted from the lineage on disk, so the plan file is
+        #: authoritative over any ctor-passed plan (same rule as the center).
+        self._plan_path = (os.path.join(state_dir, "plan.json")
+                           if state_dir else None)
+        if self._plan_path is not None and os.path.exists(self._plan_path):
+            from distkeras_tpu.netps.shards import plan as _plan_mod
+            with open(self._plan_path, "r", encoding="utf-8") as f:
+                saved = json.load(f)
+            self.shard_plan = _plan_mod.PartitionPlan.from_dict(
+                saved["plan"])
+            if self.shard_index is None:
+                self.shard_index = int(saved["shard_index"])
+                self.shard_count = self.shard_plan.num_shards
+        elif self.shard_plan is not None:
+            self._persist_plan_locked()
         self.evictions = 0
         self.rejoins = 0
         self._draining = False
@@ -515,6 +552,16 @@ class PSServer:
                 time.sleep(arg)
         if plan.fire("ps_crash", at) is not None:
             os.kill(os.getpid(), signal.SIGKILL)
+        if self.shard_index is not None:
+            # ``shard_crash@N:R``: kill SHARD N (the ``at`` slot selects the
+            # shard, not a commit count — every shard runs its own plan
+            # instance, so the index is the only shared coordinate) once it
+            # has folded R commits. Non-consuming peek first: shard k != N
+            # must not burn the one-shot.
+            arg = plan.pending("shard_crash", self.shard_index)
+            if arg is not None and self.commits_total >= (arg or 0):
+                plan.fire("shard_crash", self.shard_index)
+                os.kill(os.getpid(), signal.SIGKILL)
 
     def _dispatch(self, op: str, header: dict,
                   arrays: list) -> tuple[dict, list]:
@@ -537,6 +584,104 @@ class PSServer:
     @staticmethod
     def _err(kind: str, message: str) -> tuple[dict, list]:
         return {"error": kind, "message": message}, []
+
+    # -- sharded-center plan checks ------------------------------------
+    def _persist_plan_locked(self) -> None:
+        """Write the adopted plan next to the journal (tmp + rename): a
+        restarted shard refuses plan drift against this file, same
+        authority rule as the recovered center."""
+        if self._plan_path is None or self.shard_plan is None:
+            return
+        tmp = self._plan_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"shard_index": self.shard_index,
+                       "plan": self.shard_plan.to_dict()}, f)
+        os.replace(tmp, self._plan_path)
+
+    def _sharding_caps_locked(self) -> dict:
+        """The ``sharding`` join-reply advertisement: this shard's identity
+        plus the full plan (so a plan-less joiner — a promoted standby's
+        first client, an observer — can adopt rather than guess)."""
+        return {"index": self.shard_index, "count": self.shard_count,
+                "plan_hash": self.shard_plan.plan_hash,
+                "plan": self.shard_plan.to_dict()}
+
+    def _check_shard_join_locked(self, header: dict,
+                                 init: list) -> Optional[tuple]:
+        """The sharded-center join contract (lock held). Every violation is
+        the typed ``shard_plan`` error — a peer that cannot prove it holds
+        THE plan never gets membership, so a partial-plan fold is
+        structurally impossible (the silent-mis-fold failure class the
+        hash exists to kill)."""
+        claimed = header.get("shard_index")
+        if self.shard_index is None:
+            if claimed is not None:
+                return self._err(
+                    "shard_plan",
+                    f"this server is not part of a sharded deployment but "
+                    f"the join claims shard {claimed}")
+            return None
+        caps = header.get("caps")
+        if not isinstance(caps, dict) or not caps.get("sharding"):
+            return self._err(
+                "shard_plan",
+                "peer lacks the 'sharding' capability: pre-sharding build "
+                "joining a shard server (upgrade the worker)")
+        if claimed is None:
+            return self._err(
+                "shard_plan",
+                f"join carries no shard_index; this is shard "
+                f"{self.shard_index}/{self.shard_count} — dial it through "
+                f"a sharded client, not a plain PSClient")
+        if int(claimed) != self.shard_index:
+            return self._err(
+                "shard_plan",
+                f"join claims shard {claimed} but this server is shard "
+                f"{self.shard_index}/{self.shard_count}")
+        got_hash = header.get("plan_hash")
+        if self.shard_plan is None:
+            # Empty shard meets its first client: adopt (then persist) the
+            # plan the join carries — but only a REAL plan; "adopt" from
+            # both sides means nobody holds one.
+            plan_dict = header.get("shard_plan")
+            if not isinstance(plan_dict, dict) or got_hash == "adopt":
+                return self._err(
+                    "shard_plan",
+                    "server has no partition plan yet; join must carry "
+                    "one (shard_plan + plan_hash)")
+            from distkeras_tpu.netps.shards import plan as _plan_mod
+            try:
+                plan = _plan_mod.PartitionPlan.from_dict(plan_dict)
+            except Exception as e:  # noqa: BLE001 - answered typed
+                return self._err("shard_plan", f"malformed plan: {e}")
+            if plan.num_shards != self.shard_count:
+                return self._err(
+                    "shard_plan",
+                    f"plan has {plan.num_shards} shards, this deployment "
+                    f"has {self.shard_count}")
+            if got_hash != plan.plan_hash:
+                return self._err(
+                    "shard_plan",
+                    f"plan_hash {str(got_hash)[:12]}... does not match the "
+                    f"carried plan ({plan.plan_hash[:12]}...)")
+            self.shard_plan = plan
+            self._persist_plan_locked()
+        elif got_hash != "adopt" and \
+                got_hash != self.shard_plan.plan_hash:
+            return self._err(
+                "shard_plan",
+                f"plan hash mismatch: yours {str(got_hash)[:12]}..., this "
+                f"shard's {self.shard_plan.plan_hash[:12]}... — the "
+                f"deployment was re-planned; rebuild or adopt")
+        if init and self._center is None:
+            want = self.shard_plan.shard_shapes(self.shard_index)
+            got = [tuple(np.asarray(a).shape) for a in init]
+            if got != want:
+                return self._err(
+                    "shard_plan",
+                    f"init arrays do not match shard {self.shard_index}'s "
+                    f"plan slice: got {got[:4]}..., want {want[:4]}...")
+        return None
 
     def _purge_pending(self, wid: int, below_seq: Optional[int] = None,
                        ) -> None:
@@ -566,6 +711,9 @@ class PSServer:
                 return err
             if self._draining:
                 return self._err("draining", "server is draining")
+            shard_err = self._check_shard_join_locked(header, init)
+            if shard_err is not None:
+                return shard_err
             if wid is None:
                 wid = (max(self._ever) + 1) if self._ever else 0
             wid = int(wid)
@@ -588,6 +736,8 @@ class PSServer:
             center = [a.copy() for a in self._center]
             updates = self._updates
             last_seq = self._last_seq.get(wid, -1)
+            sharding = (self._sharding_caps_locked()
+                        if self.shard_index is not None else None)
         if rejoin:
             telemetry.counter("netps.rejoins").add(1)
             telemetry.event("netps_rejoin", {"worker": wid})
@@ -603,6 +753,10 @@ class PSServer:
         caps = dict(wire.CAPS)
         if self._uds_path is not None and "shm" in caps:
             caps["shm"] = {"boot_id": self._boot_id, "uds": self._uds_path}
+        if sharding is not None:
+            # A shard server replaces the static bit with its identity +
+            # plan, the same pattern the shm upgrade uses.
+            caps["sharding"] = sharding
         return ({"ok": True, "worker_id": wid, "updates": updates,
                  "lease_s": self.lease_s, "last_seq": last_seq,
                  "epoch": self.epoch, "caps": caps}, center)
@@ -614,6 +768,14 @@ class PSServer:
             err = self._check_primary_locked(header)
             if err is not None:
                 return err
+            if header.get("want_plan") and self.shard_index is not None:
+                # Membership-free plan fetch (the observer bootstrap): the
+                # advertisement alone, no center payload, no lease.
+                if self.shard_plan is None:
+                    return self._err("uninitialized",
+                                     "shard has no plan yet")
+                return {"ok": True, "updates": self._updates,
+                        "sharding": self._sharding_caps_locked()}, []
             if self._center is None:
                 return self._err("uninitialized", "no center yet")
             if wid is not None:
@@ -635,7 +797,13 @@ class PSServer:
                 except (IndexError, TypeError, ValueError):
                     return self._err(
                         "protocol", f"bad pull stripe indices {idx!r}")
-            return {"ok": True, "updates": self._updates}, out
+            reply = {"ok": True, "updates": self._updates}
+            if self.shard_index is not None and self.shard_plan is not None:
+                # Every pull re-proves the plan identity: a client that
+                # kept running across a re-plan sees the hash change and
+                # fails typed instead of assembling from two plans.
+                reply["plan_hash"] = self.shard_plan.plan_hash
+            return reply, out
 
     def _op_commit(self, header: dict, arrays: list) -> tuple[dict, list]:
         from distkeras_tpu import telemetry

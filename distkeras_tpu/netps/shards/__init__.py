@@ -1,0 +1,31 @@
+"""Sharded center plane: the center (and its optimizer-state byte budget)
+partitioned across N independent parameter servers.
+
+A :class:`PartitionPlan` — regex rules over parameter names with a
+byte-balanced default, row-splitting tensors too big for one shard —
+assigns every tensor slice to a shard. Each shard is a full
+:class:`~distkeras_tpu.netps.server.PSServer` (own journal/snapshot
+lineage, own warm standby, own epoch fence) and a
+:class:`ShardedPSClient` fans pulls/commits out under one logical seq,
+ACKing only when every shard folded. Plan identity is hash-validated at
+join and on every pull, so a mismatched plan is a typed
+:class:`~distkeras_tpu.netps.errors.ShardPlanError`, never a silent
+mis-fold. docs/SHARDING.md has the full contract.
+"""
+
+from distkeras_tpu.netps.shards.client import (ShardedPSClient,
+                                               is_sharded_endpoint,
+                                               make_ps_client)
+from distkeras_tpu.netps.shards.group import ShardSet
+from distkeras_tpu.netps.shards.plan import (PartitionPlan, parse_rules,
+                                             plan_for_model)
+
+__all__ = [
+    "PartitionPlan",
+    "ShardSet",
+    "ShardedPSClient",
+    "is_sharded_endpoint",
+    "make_ps_client",
+    "parse_rules",
+    "plan_for_model",
+]

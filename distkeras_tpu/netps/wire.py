@@ -101,8 +101,15 @@ CODECS = (CODEC_NONE, CODEC_BF16, CODEC_INT8)
 #: ``distkeras_tpu/serving/``) — a frontend answers them, a PS rejects
 #: them with the usual typed unknown-op error; the bit lets a probing
 #: client tell the two apart without sending a payload.
+#: ``sharding`` advertises the sharded center plane (``netps/shards/``):
+#: a :class:`~distkeras_tpu.netps.shards.client.ShardedPSClient` only
+#: joins peers carrying the bit, and a shard SERVER only admits joiners
+#: whose caps carry it AND whose join header carries a matching partition
+#: plan hash — a PR 5-11 peer (no bit) or a plan-less same-build client
+#: gets a typed :class:`~distkeras_tpu.netps.errors.ShardPlanError` at
+#: join time instead of silently folding a partial plan.
 CAPS = {"codecs": list(CODECS), "striping": True, "shm": True,
-        "replication": True, "serving": True}
+        "replication": True, "serving": True, "sharding": True}
 
 #: serving-plane ops carried in ``header["op"]`` over the SAME frame
 #: format (length prefix, crc32, request-id echo) — the serving frontend
@@ -510,3 +517,19 @@ def split_endpoints(endpoints: str) -> list[tuple[str, int]]:
     if not out:
         raise ValueError(f"no endpoints in {endpoints!r}")
     return out
+
+
+def split_shard_endpoints(endpoints: str) -> list[str]:
+    """The shard x failover endpoint matrix: ``;`` separates shards, ``,``
+    separates each shard's failover list (primary first, then standbys) —
+    ``"p0:7077,s0:7078;p1:7177,s1:7178"`` is a two-shard deployment with a
+    warm standby per shard. Returns one failover-list STRING per shard (the
+    form :class:`~distkeras_tpu.netps.client.PSClient` takes), validated;
+    an endpoint without ``;`` parses to a one-element list, so callers can
+    probe ``len() > 1`` to detect a sharded deployment."""
+    groups = [g.strip() for g in endpoints.split(";") if g.strip()]
+    if not groups:
+        raise ValueError(f"no endpoints in {endpoints!r}")
+    for g in groups:
+        split_endpoints(g)  # typed error on any malformed member
+    return groups

@@ -88,13 +88,21 @@ _KINDS = frozenset({
 #: walk the replica list), ``serve_drop`` kills request F's connection
 #: without a reply (the client sees a transport failure and fails over;
 #: the shed-before-accept contract still answers every ACCEPTED request
-#: whose connection survives).
+#: whose connection survives). ``shard_crash@N:R`` is the sharded-center
+#: drill: SIGKILL SHARD N of a sharded PS deployment once it has folded R
+#: commits — the ``at`` slot selects the shard index (every shard process
+#: consults its own plan instance, so the index is the only coordinate
+#: they share), and the arg is the commit threshold. Consumed by the shard
+#: server via the non-consuming :meth:`FaultPlan.pending` peek (shard
+#: k != N must not burn the one-shot), fired in the killed shard's own
+#: process.
 _NET_KINDS = frozenset({
     "delay", "drop", "dup", "truncate", "partition", "evict",
     "delay_r", "drop_r", "dup_r", "truncate_r",
     "shm_delay", "shm_corrupt",
     "ps_crash", "ps_hang", "preempt",
     "serve_slow", "serve_drop",
+    "shard_crash",
 })
 
 
@@ -191,6 +199,19 @@ class FaultPlan:
 
         telemetry.counter("resilience.faults_injected").add(1)
         telemetry.event("fault_injected", {"fault": kind, "at": at})
+        return arg if arg is not None else 0.0
+
+    def pending(self, kind: str, at: int) -> Optional[float]:
+        """Non-consuming peek: the arg (0.0 when argless) if ``(kind, at)``
+        is scheduled and NOT yet fired, else None. For conditional faults
+        whose trigger is checked repeatedly before it holds (the shard
+        server polls ``shard_crash`` every commit until the threshold) —
+        :meth:`fire` there would burn the one-shot on the first look."""
+        key = (kind, at)
+        with self._lock:
+            if key not in self.faults or key in self._fired:
+                return None
+            arg = self.faults[key]
         return arg if arg is not None else 0.0
 
     # -- queries (all one-shot) ----------------------------------------

@@ -223,6 +223,26 @@ ENV_REGISTRY: dict = _declare(
            "promotes itself when the primary's lease lapses, and fences "
            "the old epoch. Empty = run as a primary.",
            "network"),
+    EnvVar("DKTPU_PS_SHARD_RULES", "str", "",
+           "Partition rules for the sharded center plane: `regex=target` "
+           "entries separated by `;`, first match wins, where target is a "
+           "shard index (pin) or `split` (row-split across all shards); "
+           "parameters matching no rule are byte-balanced greedily. Empty "
+           "= fully rule-free balancing. See docs/SHARDING.md.",
+           "sharding"),
+    EnvVar("DKTPU_PS_SHARD_CAP_BYTES", "int", 0,
+           "Per-shard byte budget (center + optimizer-state factor) the "
+           "PartitionPlan must fit: tensors over the cap row-split, and a "
+           "plan whose fattest shard still exceeds it is a typed "
+           "`ShardPlanError` at build time — never an OOM at fold time. "
+           "0 = unlimited.",
+           "sharding"),
+    EnvVar("DKTPU_PS_SHARD_OPT_FACTOR", "float", -1.0,
+           "Optimizer-state byte multiplier the plan budgets per parameter "
+           "byte (adagrad accumulators ~= 1.0): shard load = center bytes "
+           "x (1 + factor). Negative = measure it from the transform's "
+           "actual state leaves at launch (`plan_for_model`).",
+           "sharding"),
     EnvVar("DKTPU_FLEET_CAPACITY", "int", 0,
            "Worker-slot capacity of a FleetScheduler constructed without an "
            "explicit `capacity=`; 0 = no default (the constructor then "

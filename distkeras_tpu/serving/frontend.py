@@ -37,6 +37,7 @@ import numpy as np
 
 from distkeras_tpu.fleet import ports
 from distkeras_tpu.netps import wire
+from distkeras_tpu.netps.endpoints import EndpointWalker
 from distkeras_tpu.netps.errors import ProtocolError, RPCTimeoutError
 from distkeras_tpu.resilience import faults as _faults
 from distkeras_tpu.resilience.backoff import full_jitter
@@ -313,24 +314,34 @@ class ServeClient:
     def __init__(self, endpoints: str, timeout: Optional[float] = None,
                  retries: Optional[int] = None,
                  backoff: Optional[float] = None):
-        self.endpoints = wire.split_endpoints(endpoints)
+        #: shared failover mechanics (``netps/endpoints.py``): split order
+        #: and walk semantics are the same contract PSClient rides.
+        self._walker = EndpointWalker(endpoints)
         self.timeout = (timeout if timeout is not None
                         else config.env_float("DKTPU_NET_TIMEOUT"))
         self.retries = (retries if retries is not None
                         else config.env_int("DKTPU_NET_RETRIES"))
         self.backoff = (backoff if backoff is not None
                         else config.env_float("DKTPU_NET_BACKOFF"))
-        self._idx = 0
         self._sock: Optional[socket.socket] = None
         self._req = itertools.count()
         self._lock = threading.Lock()
+
+    @property
+    def endpoints(self) -> list:
+        """Ordered (host, port) replica list (compat alias)."""
+        return self._walker.endpoints
+
+    @property
+    def _idx(self) -> int:
+        return self._walker.index
 
     # -- transport ----------------------------------------------------------
 
     def _connect(self) -> socket.socket:
         if self._sock is not None:
             return self._sock
-        host, port = self.endpoints[self._idx % len(self.endpoints)]
+        host, port = self._walker.current()
         sock = socket.create_connection((host, port), timeout=self.timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
@@ -338,14 +349,19 @@ class ServeClient:
 
     def _fail_over(self) -> None:
         """Drop the connection and advance to the next endpoint — the HA
-        walk (``wire.split_endpoints`` order: primary, then the rest)."""
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-            self._sock = None
-        self._idx += 1
+        walk (``wire.split_endpoints`` order: primary, then the rest).
+        ``advance`` is the unconditional single-threaded form: one request
+        in flight under ``_lock``, every failure is ours."""
+
+        def teardown():
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+        self._walker.advance(on_walk=teardown)
 
     def _rpc(self, header: dict, arrays) -> tuple[dict, list]:
         from distkeras_tpu import telemetry

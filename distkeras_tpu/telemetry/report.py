@@ -226,6 +226,47 @@ def serving_summary(summary: dict) -> Optional[dict]:
     return out or None
 
 
+def shard_summary(summary: dict) -> Optional[dict]:
+    """Roll up the sharded center plane's metrics: per-shard fold/byte
+    counters (``netps.shard.folds.<k>`` / ``netps.shard.bytes.<k>``), the
+    shard count and plan byte skew gauges, and the partial-commit count —
+    the balance evidence for a partition plan lives right here (a skew
+    near 1.0 and near-equal fold columns mean the byte-balancer did its
+    job). None when the run had no sharded center."""
+    counters = summary.get("counters", {})
+    gauges = summary.get("gauges", {})
+    folds: dict = {}
+    nbytes: dict = {}
+    for name, v in counters.items():
+        parts = name.split(".")
+        if len(parts) == 4 and parts[:2] == ["netps", "shard"]:
+            try:
+                k = int(parts[3])
+            except ValueError:
+                continue
+            if parts[2] == "folds":
+                folds[k] = v
+            elif parts[2] == "bytes":
+                nbytes[k] = v
+    out: dict = {}
+    if folds:
+        out["per_shard_folds"] = [folds.get(k, 0.0)
+                                  for k in range(max(folds) + 1)]
+    if nbytes:
+        out["per_shard_bytes"] = [nbytes.get(k, 0.0)
+                                  for k in range(max(nbytes) + 1)]
+    count = gauges.get("netps.shard.count")
+    if count is not None:
+        out["shard_count"] = count.get("value")
+    skew = gauges.get("netps.shard.skew")
+    if skew is not None:
+        out["plan_skew"] = skew.get("value")
+    partial = counters.get("netps.shard.partial_commits")
+    if partial is not None:
+        out["partial_commits"] = partial
+    return out or None
+
+
 def straggler_table(rounds: list[dict], k: float = STRAGGLER_K) -> list[dict]:
     """Rounds whose wall time exceeds ``k`` x the median round time (plus
     any rounds the live monitor already flagged). Burst-tail rounds
@@ -275,6 +316,7 @@ def build_report(path: str, k: float = STRAGGLER_K) -> dict:
         "stragglers": straggler_table(rounds, k),
         "fleet": fleet_attribution(merged),
         "serving": serving_summary(merged),
+        "shards": shard_summary(merged),
         "losses": [r["loss"] for r in rounds if "loss" in r],
     }
 
@@ -376,6 +418,23 @@ def render_report(report: dict) -> str:
           f"({sv.get('swap_failures', 0):.0f} rejected)   "
           f"retraces after warmup: "
           f"{sv.get('retrace_after_warmup', 0):.0f}\n")
+
+    if report.get("shards"):
+        sh = report["shards"]
+        w("\n## Sharded center\n")
+        if "shard_count" in sh:
+            skew = sh.get("plan_skew")
+            w(f"shards: {sh['shard_count']:.0f}   plan byte skew: "
+              f"{(f'{skew:.3f}' if skew is not None else '-')}\n")
+        if sh.get("per_shard_folds"):
+            w(f"per-shard folds: "
+              f"{[int(v) for v in sh['per_shard_folds']]}\n")
+        if sh.get("per_shard_bytes"):
+            w(f"per-shard bytes: "
+              f"{[int(v) for v in sh['per_shard_bytes']]}\n")
+        if sh.get("partial_commits"):
+            w(f"partial commits (reconciled): "
+              f"{sh['partial_commits']:.0f}\n")
 
     w("\n## Stragglers\n")
     if report["stragglers"]:

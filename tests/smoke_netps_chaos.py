@@ -39,8 +39,22 @@ epochs must be nondecreasing — the zero-stale-epoch-folds evidence::
         python tests/smoke_netps_chaos.py          # cold-restart path
     DKTPU_PS_STANDBY=1 DKTPU_PS_STATE_DIR=/tmp/ps-state ...  # failover path
 
+**Kill-one-shard mode** (``NETPS_SMOKE_SHARDS=N`` + state dir): the
+center is partitioned across N shard subprocesses (``--shard k/N``),
+each with its own journal lineage AND its own warm standby; shard 1's
+primary carries ``shard_crash@1:R`` in its fault plan and SIGKILLs
+itself mid-run, its standby promotes and fences the epoch, and the
+trainer's sharded clients walk only that shard's endpoint group — the
+other shards never notice. Exactly-once is asserted on EVERY shard's
+journal, epochs must be nondecreasing per lineage, and the victim
+shard's standby must have promoted past epoch 0::
+
+    NETPS_SMOKE_SHARDS=2 DKTPU_PS_STATE_DIR=/tmp/ps-state \\
+        python tests/smoke_netps_chaos.py          # sharded failover path
+
 All seeds are pinned (data rng, trainer seed, fault-plan seeds, the
-``ps_crash`` commit index), so reruns schedule the same chaos.
+``ps_crash``/``shard_crash`` commit indices), so reruns schedule the
+same chaos.
 """
 
 import os
@@ -219,6 +233,86 @@ def _run_failover(df, model) -> int:
     return 0
 
 
+def _run_sharded(df, model) -> int:
+    """Kill-one-shard mode: N shard primaries + N warm standbys, shard 1
+    SIGKILLed by its own ``shard_crash`` plan mid-run; its standby
+    promotes while the other shards keep folding undisturbed."""
+    import subprocess
+
+    n = int(os.environ["NETPS_SMOKE_SHARDS"])
+    base = os.environ["DKTPU_PS_STATE_DIR"]
+    os.makedirs(base, exist_ok=True)
+    victim = min(1, n - 1)
+    shard_faults = os.environ.get(
+        "NETPS_SMOKE_SHARD_FAULTS", f"shard_crash@{victim}:12;seed=3")
+    groups, procs, primaries = [], [], []
+    for k in range(n):
+        p_port, s_port = _free_port(), _free_port()
+        p_dir = os.path.join(base, f"shard-{k}")
+        # Every primary carries the SAME plan: shard_crash@{victim} only
+        # fires where the --shard index matches, so the non-victims parse
+        # it and never trip. The fired-faults journal keeps it one-shot.
+        primary = _launch_ps(
+            p_port, p_dir,
+            {"DKTPU_NET_FAULTS": shard_faults,
+             "DKTPU_FAULTS_STATE": os.path.join(p_dir, "faults.journal")},
+            "--shard", f"{k}/{n}")
+        standby = _launch_ps(
+            s_port, p_dir + ".standby", {},
+            "--standby", f"127.0.0.1:{p_port}", "--promote-after", "1.5",
+            "--shard", f"{k}/{n}")
+        procs += [primary, standby]
+        primaries.append(primary)
+        groups.append(f"127.0.0.1:{p_port},127.0.0.1:{s_port}")
+    endpoint = ";".join(groups)
+    try:
+        trainer = ADAG(model, loss="sparse_categorical_crossentropy",
+                       num_workers=4, batch_size=16, num_epoch=3,
+                       learning_rate=0.1, communication_window=4,
+                       seed=0, remote=endpoint)
+        trained = trainer.train(df, shuffle=True)
+    finally:
+        # Crash evidence BEFORE teardown: the terminate/kill escalation
+        # below must never masquerade as the injected shard_crash.
+        victim_crashed = primaries[victim].poll() not in (0, None)
+        bystanders_alive = all(primaries[k].poll() is None
+                               for k in range(n) if k != victim)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(timeout=10)
+    acc = float((np.asarray(trained.predict(jnp.asarray(
+        df["features"]))).argmax(-1) == df["label"]).mean())
+    reg = telemetry.get()
+    retries = reg.counter("netps.retries").value
+    walks = reg.counter("netps.endpoint_walks").value
+    journaled = []
+    for k in range(n):
+        records, _ = _assert_journal_invariants(
+            os.path.join(base, f"shard-{k}"), f"shard-{k}")
+        journaled.append(len(records))
+    sb_records, sb_epoch = _assert_journal_invariants(
+        os.path.join(base, f"shard-{victim}.standby"),
+        f"shard-{victim}-standby")
+    print(f"netps kill-one-shard ({n} shards): acc={acc:.4f} "
+          f"journaled={journaled} standby_journaled={len(sb_records)} "
+          f"standby_epoch={sb_epoch} client_retries={retries:.0f} "
+          f"endpoint_walks={walks:.0f}")
+    assert victim_crashed, "shard_crash never fired — the drill tested nothing"
+    assert bystanders_alive, "a non-victim shard died: the blast radius leaked"
+    assert sb_epoch >= 1, (
+        f"shard {victim}'s standby never promoted past epoch 0")
+    assert walks >= 1, "no client ever walked the victim's endpoint group"
+    assert acc >= 0.99, f"accuracy collapsed across the shard crash: {acc}"
+    assert all(j >= 10 for j in journaled), (
+        f"a shard journal is implausibly short: {journaled}")
+    return 0
+
+
 def main() -> int:
     rng = np.random.default_rng(0)
     centers = rng.normal(scale=4.0, size=(3, 4))
@@ -228,6 +322,8 @@ def main() -> int:
                     "label": y.astype(np.int32)})
     model = Model.build(MLP(hidden=(16,), num_outputs=3),
                         jnp.zeros((1, 4), jnp.float32), seed=0)
+    if int(os.environ.get("NETPS_SMOKE_SHARDS") or 0) > 1:
+        return _run_sharded(df, model)
     if os.environ.get("DKTPU_PS_STATE_DIR"):
         return _run_failover(df, model)
     server = PSServer(discipline="adag", lease_s=1.0).start()

@@ -1,0 +1,595 @@
+"""The sharded center plane: partition plans (rules, caps, row splits,
+hash identity), the shared endpoint walker, the ShardedPSClient fan-out
+protocol over in-process ShardSet gangs, the typed rejection paths, the
+fleet gang placement, and the report/fault plumbing.
+
+The headline guarantees pinned here:
+
+* **Parity** — a 2-shard center driven by the same deterministic commits
+  as a single PS ends bit-identical: sharding changes WHERE tensors live,
+  never what is folded into them.
+* **Never a silent mis-fold** — every way two peers can disagree about
+  the plan (hash mismatch, plan-unaware peer, plain client on a shard
+  server, shard claim on a plain server) answers a typed
+  ``ShardPlanError`` at join, before any tensor moves.
+* **Exactly-once per shard** — one logical seq per commit; a same-seq
+  retransmit dedups on every shard that already folded it.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu.netps import wire
+from distkeras_tpu.netps.client import PSClient
+from distkeras_tpu.netps.endpoints import EndpointWalker, budget_left
+from distkeras_tpu.netps.errors import ProtocolError, ShardPlanError
+from distkeras_tpu.netps.server import PSServer
+from distkeras_tpu.netps.shards import (
+    PartitionPlan,
+    ShardedPSClient,
+    ShardSet,
+    is_sharded_endpoint,
+    make_ps_client,
+    parse_rules,
+    plan_for_model,
+)
+from distkeras_tpu.resilience.faults import FaultPlan
+
+FAST = dict(timeout=2.0, retries=3, backoff=0.01)
+
+
+def leaves():
+    # No scalar () leaves here: the wire codec carries scalars as (1,)
+    # (a pre-existing plain-PS limitation, not a sharding one); scalars
+    # are covered by the in-process plan tests below.
+    rng = np.random.default_rng(7)
+    return [rng.normal(size=(8, 3)).astype(np.float32),
+            rng.normal(size=(4,)).astype(np.float32),
+            rng.normal(size=(2, 2)).astype(np.float32)]
+
+
+# ---------------------------------------------------------------------------
+# PartitionPlan
+# ---------------------------------------------------------------------------
+
+class TestPartitionPlan:
+    def test_parse_rules(self):
+        rules = parse_rules("kernel=0; bias = 1 ;embed=split")
+        assert rules == [("kernel", 0), ("bias ", 1), ("embed", "split")]
+        assert parse_rules("") == []
+
+    @pytest.mark.parametrize("spec", [
+        "kernel", "kernel=banana", "(=0",
+    ])
+    def test_parse_rules_rejects_malformed(self, spec):
+        with pytest.raises(ShardPlanError):
+            parse_rules(spec)
+
+    def test_balanced_default_covers_everything_once(self):
+        names = [f"t{i}" for i in range(7)]
+        shapes = [(64, 8), (32, 8), (16, 8), (8, 8), (4,), (2,), ()]
+        plan = PartitionPlan.build(names, shapes, 3)
+        assert plan.num_shards == 3
+        # Every tensor assigned exactly once, no splits without a reason.
+        assert all(len(s) == 1 for s in plan.segments)
+        assert sum(plan.loads) == sum(
+            4 * max(1, int(np.prod(s))) for s in shapes)
+        # Greedy largest-first keeps the byte skew bounded by the
+        # dominant tensor (2048 B of 3868 B total here).
+        assert plan.skew() < 2.0
+
+    def test_pin_rule_wins_over_balance(self):
+        plan = PartitionPlan.build(["a/kernel", "b/bias"], [(64, 8), (64,)],
+                                   2, rules=[("kernel", 1)])
+        assert plan.segments[0] == [(1, 0, 64)]
+
+    def test_pin_rule_out_of_range(self):
+        with pytest.raises(ShardPlanError):
+            PartitionPlan.build(["a"], [(4, 4)], 2, rules=[("a", 5)])
+
+    def test_split_rule_row_splits(self):
+        plan = PartitionPlan.build(["big", "small"], [(10, 4), (3,)], 2,
+                                   rules=[("big", "split")])
+        segs = plan.segments[0]
+        assert [k for k, _, _ in segs] == [0, 1]
+        assert segs[0][1:] == (0, 5) and segs[1][1:] == (5, 10)
+        # A scalar "split" degrades to the balanced default, never errors.
+        p2 = PartitionPlan.build(["s"], [()], 2, rules=[("s", "split")])
+        assert len(p2.segments[0]) == 1
+
+    def test_cap_forces_split_and_rejects_overflow(self):
+        # 10x4 f32 = 160 B: a 100 B cap forces the row split...
+        plan = PartitionPlan.build(["big"], [(10, 4)], 2, cap_bytes=100)
+        assert len(plan.segments[0]) == 2
+        assert all(b <= 100 for b in plan.loads)
+        # ...and a cap no split can satisfy is a typed error, not an OOM.
+        with pytest.raises(ShardPlanError, match="per-shard cap"):
+            PartitionPlan.build(["big"], [(10, 4)], 2, cap_bytes=50)
+
+    def test_opt_factor_budgets_optimizer_state(self):
+        # 160 B center fits a 200 B cap alone; with Adam's ~2x optimizer
+        # shadow (480 B budgeted) one shard overflows, two carry it.
+        PartitionPlan.build(["w"], [(10, 4)], 1, cap_bytes=200)
+        with pytest.raises(ShardPlanError):
+            PartitionPlan.build(["w"], [(10, 4)], 1, cap_bytes=200,
+                                opt_factor=2.0)
+        plan = PartitionPlan.build(["w"], [(10, 4)], 2, cap_bytes=250,
+                                   opt_factor=2.0)
+        assert len(plan.segments[0]) == 2
+
+    def test_hash_roundtrip_and_identity(self):
+        plan = PartitionPlan.build(["a", "b"], [(8, 3), (4,)], 2)
+        again = PartitionPlan.from_json(plan.to_json())
+        assert again == plan
+        assert again.plan_hash == plan.plan_hash
+        other = PartitionPlan.build(["a", "b"], [(8, 3), (4,)], 3)
+        assert other.plan_hash != plan.plan_hash
+
+    def test_from_dict_rejects_malformed(self):
+        plan = PartitionPlan.build(["a"], [(4,)], 1)
+        d = plan.to_dict()
+        with pytest.raises(ShardPlanError):
+            PartitionPlan.from_dict({**d, "version": 99})
+        with pytest.raises(ShardPlanError):
+            PartitionPlan.from_dict({"num_shards": 1})
+        with pytest.raises(ShardPlanError):
+            PartitionPlan.from_json("{not json")
+
+    def test_scatter_assemble_roundtrip(self):
+        rng = np.random.default_rng(0)
+        tensors = [rng.normal(size=(9, 2)).astype(np.float32),
+                   rng.normal(size=(5,)).astype(np.float32),
+                   np.float32(3.0).reshape(())]
+        plan = PartitionPlan.from_arrays(tensors, 3,
+                                         rules=[("param_0000", "split")])
+        back = plan.assemble(plan.scatter(tensors))
+        for a, b in zip(tensors, back):
+            np.testing.assert_array_equal(a, b)
+        # shard_shapes agrees with what scatter actually produces.
+        for k in range(3):
+            got = [tuple(a.shape) for a in plan.shard_slice(tensors, k)]
+            assert got == [tuple(s) for s in plan.shard_shapes(k)]
+
+    def test_assemble_rejects_skew(self):
+        plan = PartitionPlan.build(["a", "b"], [(4, 2), (3,)], 2)
+        per_shard = plan.scatter([np.zeros((4, 2), np.float32),
+                                  np.zeros((3,), np.float32)])
+        with pytest.raises(ShardPlanError):
+            plan.assemble(per_shard[:1])
+        with pytest.raises(ShardPlanError):
+            plan.assemble([per_shard[0], per_shard[1] + [np.zeros(1)]])
+
+    def test_plan_for_model_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("DKTPU_PS_SHARD_OPT_FACTOR", "0")
+        p0 = plan_for_model(leaves(), 2, opt_factor=2.0)
+        monkeypatch.delenv("DKTPU_PS_SHARD_OPT_FACTOR")
+        p1 = plan_for_model(leaves(), 2, opt_factor=2.0)
+        # The env override (=0) zeroed the measured factor: loads differ.
+        assert sum(p0.loads) < sum(p1.loads)
+
+
+# ---------------------------------------------------------------------------
+# EndpointWalker (the shared failover mechanics)
+# ---------------------------------------------------------------------------
+
+class TestEndpointWalker:
+    def test_cas_walk_moves_one_step(self):
+        w = EndpointWalker("a:1,b:2,c:3")
+        assert w.current() == ("a", 1)
+        seen = w.index
+        assert w.walk(seen) is True
+        # A sibling that saw the SAME failure does not double-advance.
+        assert w.walk(seen) is False
+        assert w.current() == ("b", 2)
+
+    def test_single_endpoint_never_walks(self):
+        w = EndpointWalker("a:1")
+        assert w.walk(w.index) is False
+        assert w.patience(lease_s=5.0, timeout=1.0) is None
+
+    def test_walk_runs_teardown_only_on_win(self):
+        w = EndpointWalker("a:1,b:2")
+        calls = []
+        w.walk(w.index, on_walk=lambda: calls.append("win"))
+        w.walk(0, on_walk=lambda: calls.append("lose"))
+        assert calls == ["win"]
+
+    def test_advance_wraps(self):
+        w = EndpointWalker("a:1,b:2")
+        w.advance()
+        w.advance()
+        assert w.current() == ("a", 1)
+
+    def test_patience_and_budget(self):
+        w = EndpointWalker("a:1,b:2")
+        deadline = w.patience(lease_s=0.5, timeout=0.25)
+        assert deadline is not None
+        assert deadline - time.monotonic() == pytest.approx(1.25, abs=0.1)
+        assert budget_left(0, 3, None) is True
+        assert budget_left(2, 3, None) is False
+        assert budget_left(99, 3, time.monotonic() + 10) is True
+        assert budget_left(99, 3, time.monotonic() - 1) is False
+
+    def test_split_shard_endpoints(self):
+        groups = wire.split_shard_endpoints("a:1,b:2;c:3;d:4,e:5")
+        assert groups == ["a:1,b:2", "c:3", "d:4,e:5"]
+        assert is_sharded_endpoint("a:1,b:2;c:3")
+        assert not is_sharded_endpoint("a:1,b:2")
+
+
+# ---------------------------------------------------------------------------
+# ShardedPSClient end-to-end over an in-process ShardSet
+# ---------------------------------------------------------------------------
+
+def drive(client, n, *, worker_seed=1):
+    """Join + fold ``n`` deterministic commits; returns the final pulled
+    center (deltas depend only on ``worker_seed``, so a single-PS run and
+    a sharded run fold identical streams)."""
+    rng = np.random.default_rng(worker_seed)
+    center, counter = client.join(init=leaves())
+    for _ in range(n):
+        delta = [rng.normal(scale=0.1, size=a.shape).astype(np.float32)
+                 for a in center]
+        res = client.commit(delta, counter)
+        assert res.applied and not res.evicted
+        center, counter = client.pull()
+    return center
+
+
+class TestShardedClient:
+    def test_factory_routes_by_endpoint_shape(self):
+        with ShardSet(2, center=leaves()) as ss:
+            c = make_ps_client(ss.endpoint, **FAST)
+            assert isinstance(c, ShardedPSClient)
+            c.close()
+        srv = PSServer(center=leaves()).start()
+        try:
+            c = make_ps_client(srv.endpoint, **FAST)
+            assert isinstance(c, PSClient)
+            c.close()
+        finally:
+            srv.close()
+
+    def test_two_shard_parity_with_single_ps(self):
+        # The same deterministic commit stream into a single PS and into
+        # a 2-shard gang must end bit-identical: sharding changes WHERE
+        # tensors live, never what is folded.
+        srv = PSServer(center=leaves(), discipline="adag").start()
+        try:
+            c = PSClient(srv.endpoint, **FAST)
+            single = drive(c, 4)
+            c.leave()
+            c.close()
+        finally:
+            srv.close()
+        with ShardSet(2, center=leaves(), discipline="adag") as ss:
+            c = ShardedPSClient(ss.endpoint, plan=ss.plan, **FAST)
+            sharded = drive(c, 4)
+            c.leave()
+            c.close()
+        for a, b in zip(single, sharded):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_join_shares_worker_id_and_counters_are_per_shard(self):
+        with ShardSet(2, center=leaves()) as ss:
+            c = ShardedPSClient(ss.endpoint, plan=ss.plan, **FAST)
+            try:
+                center, counters = c.join(init=leaves())
+                assert isinstance(counters, tuple) and len(counters) == 2
+                assert all(s.worker_id == c.worker_id for s in c._subs)
+                for a, b in zip(center, leaves()):
+                    assert np.asarray(a).shape == np.asarray(b).shape
+            finally:
+                c.close()
+
+    def test_same_seq_retransmit_dedups_per_shard(self):
+        with ShardSet(2, center=leaves()) as ss:
+            c = ShardedPSClient(ss.endpoint, plan=ss.plan, **FAST)
+            try:
+                center, counters = c.join(init=leaves())
+                delta = [np.ones_like(np.asarray(a)) for a in center]
+                res = c.commit(delta, counters)
+                assert res.applied
+                # The reconciliation path's retransmit: the SAME logical
+                # seq resent to a shard that already folded it dedups.
+                slices = c.plan.scatter(delta)
+                for k, sub in enumerate(c._subs):
+                    res_k = sub.commit(slices[k], counters[k], seq=c._seq)
+                    assert res_k.duplicate and not res_k.applied
+                # And the fold happened exactly once.
+                after, _ = c.pull()
+                for a0, a1 in zip(leaves(), after):
+                    np.testing.assert_allclose(
+                        np.asarray(a1), np.asarray(a0) + 1.0, atol=1e-6)
+            finally:
+                c.leave()
+                c.close()
+
+    def test_observer_adopts_plan_without_init(self):
+        with ShardSet(2, center=leaves()) as ss:
+            c = ShardedPSClient(ss.endpoint, **FAST)  # no plan, no join
+            try:
+                center, counters = c.pull()
+                assert c.plan is not None
+                assert c.plan.plan_hash == ss.plan.plan_hash
+                for a, b in zip(center, leaves()):
+                    np.testing.assert_array_equal(np.asarray(a),
+                                                  np.asarray(b))
+            finally:
+                c.close()
+
+    def test_rejoin_resumes_seq_high_water_mark(self):
+        with ShardSet(2, center=leaves()) as ss:
+            c = ShardedPSClient(ss.endpoint, plan=ss.plan, **FAST)
+            center, counters = c.join(init=leaves())
+            delta = [np.zeros_like(np.asarray(a)) for a in center]
+            for _ in range(3):
+                c.commit(delta, counters)
+            seq, wid = c._seq, c.worker_id
+            c.close()
+            c2 = ShardedPSClient(ss.endpoint, worker_id=wid,
+                                 plan=ss.plan, **FAST)
+            try:
+                c2.join(init=leaves())
+                # The next commit must be a seq no shard has folded.
+                assert c2._seq >= seq
+            finally:
+                c2.leave()
+                c2.close()
+
+
+# ---------------------------------------------------------------------------
+# Typed rejections: every way to disagree about the plan
+# ---------------------------------------------------------------------------
+
+class TestPlanRejections:
+    def test_plan_hash_mismatch_is_typed(self):
+        with ShardSet(2, center=leaves()) as ss:
+            other = PartitionPlan.from_arrays(leaves(), 2,
+                                              rules=[(".*", 0)])
+            assert other.plan_hash != ss.plan.plan_hash
+            c = ShardedPSClient(ss.endpoint, plan=other, **FAST)
+            try:
+                with pytest.raises(ShardPlanError):
+                    c.join(init=leaves())
+            finally:
+                c.close()
+
+    def test_plain_client_rejected_by_shard_server(self):
+        with ShardSet(2, center=leaves()) as ss:
+            ep0 = ss.endpoint.split(";")[0]
+            c = PSClient(ep0, **FAST)
+            try:
+                with pytest.raises(ShardPlanError):
+                    c.join(init=None)
+            finally:
+                c.close()
+
+    def test_shard_claim_rejected_by_plain_server(self):
+        srv = PSServer(center=leaves()).start()
+        try:
+            fake_matrix = f"{srv.endpoint};{srv.endpoint}"
+            c = ShardedPSClient(fake_matrix,
+                                plan=plan_for_model(leaves(), 2), **FAST)
+            try:
+                with pytest.raises(ShardPlanError):
+                    c.join(init=leaves())
+            finally:
+                c.close()
+        finally:
+            srv.close()
+
+    def test_pre_sharding_peer_rejected(self, monkeypatch):
+        # An old build's caps have no "sharding" bit: the server must
+        # refuse the join with a typed error, not mis-fold silently.
+        old_caps = {k: v for k, v in wire.CAPS.items() if k != "sharding"}
+        with ShardSet(1, center=leaves()) as ss:
+            monkeypatch.setattr(wire, "CAPS", old_caps)
+            c = PSClient(ss.endpoint, **FAST)
+            try:
+                with pytest.raises(ProtocolError):
+                    c.join(init=None)
+            finally:
+                c.close()
+
+    def test_plan_num_shards_must_match_matrix(self):
+        with pytest.raises(ShardPlanError):
+            ShardedPSClient("a:1;b:2;c:3",
+                            plan=plan_for_model(leaves(), 2), **FAST)
+        with pytest.raises(ValueError):
+            ShardSet(3, plan=plan_for_model(leaves(), 2))
+
+
+# ---------------------------------------------------------------------------
+# Server-side plan persistence and identity
+# ---------------------------------------------------------------------------
+
+class TestServerPlanState:
+    def test_plan_persisted_and_adopted_on_restart(self, tmp_path):
+        plan = plan_for_model(leaves(), 2)
+        state = str(tmp_path / "shard-1")
+        srv = PSServer(shard_index=1, shard_count=2, shard_plan=plan,
+                       state_dir=state).start()
+        srv.close()
+        assert (tmp_path / "shard-1" / "plan.json").exists()
+        # A cold restart on the same dir recovers the shard identity and
+        # plan WITHOUT being told — the plan file is authoritative.
+        back = PSServer(state_dir=state)
+        try:
+            assert back.shard_index == 1 and back.shard_count == 2
+            assert back.shard_plan.plan_hash == plan.plan_hash
+        finally:
+            back.close()
+
+    def test_shard_index_range_checked(self):
+        with pytest.raises(ValueError):
+            PSServer(shard_index=2, shard_count=2)
+
+    def test_cli_shard_arg_rejects_malformed(self):
+        from distkeras_tpu.netps.__main__ import main
+
+        for bad in ("bogus", "3/2", "2/2", "-1/2"):
+            with pytest.raises(SystemExit):
+                main(["--shard", bad, "--port", "0"])
+
+
+# ---------------------------------------------------------------------------
+# Faults, hier counter folding, fleet gang placement, report section
+# ---------------------------------------------------------------------------
+
+class TestShardCrashFault:
+    def test_pending_is_non_consuming_peek(self):
+        plan = FaultPlan.parse_net("shard_crash@1:12;seed=3")
+        # The threshold poll: repeated peeks never burn the one-shot.
+        assert plan.pending("shard_crash", 1) == 12.0
+        assert plan.pending("shard_crash", 1) == 12.0
+        assert plan.pending("shard_crash", 0) is None
+        assert plan.fire("shard_crash", 1) == 12.0
+        assert plan.pending("shard_crash", 1) is None
+
+
+class TestHierCounterScalar:
+    def test_min_over_per_shard_counters(self):
+        from distkeras_tpu.netps.hier import _counter_scalar
+
+        assert _counter_scalar(7) == 7
+        assert _counter_scalar((5, 3, 9)) == 3
+        assert _counter_scalar([4]) == 4
+
+
+class TestGangPlacement:
+    def _card(self, **ps):
+        from distkeras_tpu.job_deployment import Punchcard
+
+        return Punchcard("j", "train.py", ["localhost"], ps=ps)
+
+    def test_endpoint_matrix_sticky_and_released(self):
+        pc = self._card(shards=2, standby_host="localhost",
+                        state_dir="/tmp/sd")
+        ep = pc.ps_endpoint()
+        groups = ep.split(";")
+        assert len(groups) == 2 and all("," in g for g in groups)
+        assert pc.ps_endpoint() == ep  # sticky: later renders agree
+        assert pc.ps_standby_endpoint() is None  # standbys live in matrix
+        ports = set(pc.ps["shard_ports"]) | set(pc.ps["standby_ports"])
+        assert len(ports) == 4
+        pc.release_ports()
+        assert "shard_ports" not in pc.ps and "standby_ports" not in pc.ps
+
+    def test_render_gang_commands(self):
+        from distkeras_tpu.job_deployment import Job
+
+        pc = self._card(shards=2, standby_host="localhost",
+                        state_dir="/tmp/sd", lease=5)
+        job = Job(pc)
+        ps_cmds = job.render_ps_commands()
+        sb_cmds = job.render_standby_commands()
+        assert len(ps_cmds) == len(sb_cmds) == 2
+        for k, cmd in enumerate(ps_cmds):
+            assert f"--shard {k}/2" in cmd
+            assert f"--state-dir /tmp/sd/shard-{k}" in cmd
+            assert f"--port {pc.ps['shard_ports'][k]}" in cmd
+        for k, cmd in enumerate(sb_cmds):
+            assert f"--shard {k}/2" in cmd
+            assert f"--state-dir /tmp/sd/shard-{k}.standby" in cmd
+            assert "--standby localhost:" in cmd
+        # The singular forms stay the unsharded card's exact contract.
+        assert job.render_ps_command() == ps_cmds[0]
+        pc.release_ports()
+
+    def test_unsharded_card_unchanged(self):
+        from distkeras_tpu.job_deployment import Job
+
+        pc = self._card(port=7077, state_dir="/tmp/sd")
+        job = Job(pc)
+        cmd = job.render_ps_command()
+        assert "--port 7077" in cmd and "--shard" not in cmd
+        assert job.render_ps_commands() == [cmd]
+        assert pc.ps_endpoint() == "localhost:7077"
+
+    def test_explicit_shard_ports_length_checked(self):
+        pc = self._card(shards=3, shard_ports=[7001, 7002])
+        with pytest.raises(ValueError):
+            pc.ps_endpoint()
+
+    def test_ps_plane_roster_per_shard_roles(self):
+        from distkeras_tpu.job_deployment import Job
+
+        pc = self._card(shards=2, standby_host="localhost")
+        job = Job(pc)
+        job._shard_procs = [None, None]
+        job._shard_standby_procs = [None, None]
+        roles = [r for r, *_ in job._ps_plane()]
+        assert roles == ["shard-0", "shard-1",
+                         "shard-0-standby", "shard-1-standby"]
+        pc.release_ports()
+
+
+class TestShardReport:
+    def test_shard_summary_and_render_section(self):
+        from distkeras_tpu.telemetry.report import (
+            render_report,
+            shard_summary,
+        )
+
+        summary = {
+            "counters": {"netps.shard.folds.0": 10.0,
+                         "netps.shard.folds.1": 9.0,
+                         "netps.shard.bytes.0": 4096.0,
+                         "netps.shard.bytes.1": 4000.0,
+                         "netps.shard.partial_commits": 1.0},
+            "gauges": {"netps.shard.count": {"value": 2.0},
+                       "netps.shard.skew": {"value": 1.02}},
+        }
+        sh = shard_summary(summary)
+        assert sh["per_shard_folds"] == [10.0, 9.0]
+        assert sh["per_shard_bytes"] == [4096.0, 4000.0]
+        assert sh["shard_count"] == 2.0
+        assert sh["plan_skew"] == 1.02
+        assert sh["partial_commits"] == 1.0
+        assert shard_summary({"counters": {}, "gauges": {}}) is None
+        report = {
+            "path": "x.jsonl", "rounds": 0, "total_round_seconds": 0.0,
+            "phases": [], "counters": {}, "gauges": {}, "segments": [],
+            "staleness": None, "stragglers": [], "fleet": [],
+            "serving": None, "shards": sh, "losses": [],
+        }
+        text = render_report(report)
+        assert "## Sharded center" in text
+        assert "per-shard folds: [10, 9]" in text
+        assert "plan byte skew: 1.020" in text
+
+
+# ---------------------------------------------------------------------------
+# Concurrency: multiple sharded committers, exactly-once totals
+# ---------------------------------------------------------------------------
+
+class TestConcurrentCommitters:
+    def test_two_workers_all_folds_land_once(self):
+        with ShardSet(2, center=leaves(), discipline="adag") as ss:
+            n_commits, errors = 3, []
+
+            def work(seed):
+                try:
+                    c = ShardedPSClient(ss.endpoint, plan=ss.plan, **FAST)
+                    try:
+                        drive(c, n_commits, worker_seed=seed)
+                        c.leave()
+                    finally:
+                        c.close()
+                except Exception as e:  # noqa: BLE001 - surfaced below
+                    errors.append(e)
+
+            threads = [threading.Thread(target=work, args=(s,))
+                       for s in (1, 2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors
+            # Every shard folded every worker's every commit exactly once.
+            for srv in ss.servers:
+                assert srv.commits_total == 2 * n_commits
