@@ -625,6 +625,22 @@ def _measure_netps_transformer(name, *, num_layers, d_model, num_heads, d_ff,
     # ring wins (measured; the codec stays a TCP/cross-host lever).
     shm_v = run_variant(transport="shm", inflight=2, shards=1,
                         compress="none")
+    # -- the auto arm: the self-tuning controller from a COLD start --------
+    # No data-plane knobs at all: join-time probes + the online control
+    # loop pick codec/inflight/striping (the acceptance bar is matching
+    # the best hand-tuned variant above within the run-to-run band). The
+    # chosen knobs are read back from the controller's own run summary
+    # event — the bench reports what the controller DID, not what it was
+    # expected to do.
+    from distkeras_tpu import telemetry as _telemetry
+    from distkeras_tpu.netps.tuner import recommended_topology
+
+    auto_v = run_variant(transport="shm", autotune=True)
+    auto_knobs = None
+    for ev in _telemetry.get().events():
+        if ev.get("kind") == "tuner_run_summary":
+            auto_knobs = {k: ev.get(k) for k in
+                          ("inflight", "codec", "shards", "transport")}
 
     # -- fold-throughput vs worker count: flat vs hierarchical topology ----
     # One timed run per point (the executable and sockets are warm from the
@@ -658,6 +674,10 @@ def _measure_netps_transformer(name, *, num_layers, d_model, num_heads, d_ff,
                 dt = time.perf_counter() - t0
                 hier_curve.append({
                     "workers": W, "topology": topo,
+                    # What the self-tuning controller WOULD pick at this
+                    # fan-in (the measured crossover rule) — lined up
+                    # against both measured topologies per point.
+                    "controller_topology": recommended_topology(W),
                     "tokens_per_sec": round(tokens_w / dt, 1),
                     "root_commits": len(srv.commit_log),
                     "root_commits_per_sec": round(
@@ -690,6 +710,10 @@ def _measure_netps_transformer(name, *, num_layers, d_model, num_heads, d_ff,
                 if gap > 0 else None),
             "knobs": {"inflight": 2, "compress": "none", "shards": 1,
                       "transport": "shm"},
+            "auto_tokens_per_sec": round(auto_v["value"], 1),
+            "auto_vs_best_hand_tuned": round(
+                auto_v["value"] / shm_v["value"], 3),
+            "auto_knobs": auto_knobs,
         },
         "hier_curve": hier_curve,
     }

@@ -107,7 +107,8 @@ class FleetScheduler:
                  tick_s: Optional[float] = None,
                  preempt_grace: Optional[float] = None,
                  max_restarts: Optional[int] = None,
-                 preemption: bool = True):
+                 preemption: bool = True,
+                 expansion_policy=None):
         if capacity is None:
             capacity = config.env_int("DKTPU_FLEET_CAPACITY")
         if capacity < 1:
@@ -126,6 +127,20 @@ class FleetScheduler:
             max_restarts if max_restarts is not None
             else config.env_int("DKTPU_FLEET_MAX_RESTARTS"))
         self.preemption = bool(preemption)
+        #: optional expansion gate (duck-typed: ``observe(label, workers,
+        #: progress)`` fed each tick, ``allow_expand(label, workers)``
+        #: consulted before each elastic grant) — the tuner's
+        #: :class:`~distkeras_tpu.netps.tuner.fleet.
+        #: MarginalThroughputPolicy` grows a job only while the last
+        #: granted worker measurably moved its commit rate. Gates
+        #: EXPANSION only; placement, gang minimums, and every shrink
+        #: floor are untouched. None (default, or autotune off) keeps the
+        #: static quota behavior bit-for-bit.
+        if expansion_policy is None and config.env_bool("DKTPU_NET_AUTOTUNE"):
+            from distkeras_tpu.netps.tuner.fleet import (
+                MarginalThroughputPolicy)
+            expansion_policy = MarginalThroughputPolicy()
+        self.expansion_policy = expansion_policy
         self._jobs: list = []
         #: job -> {wid: _Worker} for every slot currently occupied (a
         #: released worker occupies its slot until its thread is reaped).
@@ -619,6 +634,15 @@ class FleetScheduler:
                 if (len(self._granted[job]) >= job.max_workers
                         or self._quota_headroom(job.tenant) <= 0):
                     continue
+                if (self.expansion_policy is not None
+                        and not self.expansion_policy.allow_expand(
+                            self._label(job), self._active(job))):
+                    # Measured marginal throughput flattened at the
+                    # current grant: leave the slot for a tenant that can
+                    # still use it. Re-evaluated every tick — a later
+                    # rate change (straggler recovered, co-tenant left)
+                    # re-opens expansion.
+                    continue
                 wid = next(i for i in range(job.max_workers)
                            if i not in self._granted[job])
                 self._spawn(job, wid)
@@ -641,3 +665,10 @@ class FleetScheduler:
                 float(self._active(job)))
             telemetry.gauge(f"fleet.preempt_debt.{label}").set(
                 float(job.debt))
+            if self.expansion_policy is not None and job.state == RUNNING:
+                try:
+                    progress = int(job.runtime.progress())
+                except Exception:  # noqa: BLE001 - a dead runtime is reaped
+                    continue      # by _reap; the policy just skips a sample
+                self.expansion_policy.observe(
+                    label, self._active(job), progress)

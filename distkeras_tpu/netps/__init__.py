@@ -58,7 +58,15 @@ production edges the reference never had:
   journal lineage, warm standby, and epoch fence — and a
   :class:`ShardedPSClient` fans pulls/commits out under ONE logical seq,
   plan-hash-validated at join and on every pull (mismatch = typed
-  :class:`ShardPlanError`, never a silent mis-fold). docs/SHARDING.md.
+  :class:`ShardPlanError`, never a silent mis-fold). docs/SHARDING.md;
+* :mod:`~distkeras_tpu.netps.tuner` — the self-tuning data plane
+  (``DKTPU_NET_AUTOTUNE=1``): join-time codec micro-probes over the
+  negotiated connection plus an online controller that re-reads the live
+  gauges and retunes compression/in-flight/striping/HIER fan-in mid-run
+  through the SAME renegotiation paths a rejoin uses — guardrailed
+  (floors never crossed, bounded retune rate, oscillation falls back to
+  static, failover defers) and capability-gated so old peers see zero
+  new traffic. docs/PERFORMANCE.md "Self-tuning data plane".
 
 The data plane (compute/comms overlap, compressed deltas, sharded
 striping over ``DKTPU_NET_SHARDS`` connections, zero-copy frames) is

@@ -56,6 +56,7 @@ from __future__ import annotations
 import socket
 import threading
 import time
+import warnings
 from concurrent.futures import ThreadPoolExecutor
 from typing import NamedTuple, Optional, Sequence
 
@@ -132,6 +133,41 @@ class _Conn:
         self.dialect: Optional[str] = None
 
 
+#: measured-bad knob pairings (the PR 6 bench rules, enforced at init
+#: instead of living only in docs): (condition-name, why). Warned once
+#: per process per combo — a fleet of workers must not scream N times.
+_BAD_KNOB_COMBOS_WARNED: set = set()
+
+
+def _validate_knob_combo(codec: str, transport: str, shards: int) -> None:
+    """One-time warning + telemetry event when a measured-bad pairing is
+    forced. Purely advisory: the knobs still apply exactly as requested —
+    the user may know something the bench did not."""
+    combos = []
+    if transport == "shm" and codec == wire.CODEC_INT8:
+        combos.append((
+            "int8+shm",
+            "int8 loses on the shm ring: the quantize/dequantize passes "
+            "cost more than the bytes they save at memcpy speed "
+            "(docs/PERFORMANCE.md); prefer DKTPU_NET_COMPRESS=none"))
+    if transport == "shm" and shards > 1:
+        combos.append((
+            "shards>1+shm",
+            "striping over the shm ring pays a doorbell per stripe for "
+            "payloads that already move at memcpy speed; prefer "
+            "DKTPU_NET_SHARDS=1"))
+    for combo, why in combos:
+        if combo in _BAD_KNOB_COMBOS_WARNED:
+            continue
+        _BAD_KNOB_COMBOS_WARNED.add(combo)
+        from distkeras_tpu import telemetry
+
+        telemetry.counter("tuner.knob_warnings").add(1)
+        telemetry.event("netps_knob_warning", {"combo": combo, "why": why})
+        warnings.warn(f"measured-bad knob combination {combo}: {why}",
+                      RuntimeWarning, stacklevel=3)
+
+
 class PSClient:
     """One worker's connection(s) to a :class:`~distkeras_tpu.netps.server.
     PSServer` (or anything speaking the wire protocol, e.g. the chaos
@@ -185,6 +221,7 @@ class PSClient:
         #: is used only when the join reply advertises a same-boot-id shm
         #: endpoint — anything else silently stays on TCP.
         self.transport = transport
+        _validate_knob_combo(requested, transport, self.shards)
         #: negotiated at join; until then the PR 4 dialect (f32, 1 conn).
         self.codec = wire.CODEC_NONE
         self.active_shards = 1
@@ -209,6 +246,10 @@ class PSClient:
         #: times this client re-joined after an eviction (worker loops
         #: watch it to re-adopt the center on rejoin).
         self.rejoin_count = 0
+        #: times the endpoint walker moved off an endpoint (failover in
+        #: progress); the tuner's apply path reads it to DEFER a mid-walk
+        #: retune — the rejoin renegotiates the dialect anyway.
+        self.walk_count = 0
         #: extra header fields merged into EVERY join (including the
         #: auto-rejoin after an eviction/fence — an attribute, not a join()
         #: parameter, precisely so rejoins keep carrying it). The sharded
@@ -289,6 +330,7 @@ class PSClient:
             # shared lock, which IS that lock (see __init__) — the
             # analyzer can't see through the callback indirection.
             self.shm_info = None  # dk: disable=DK202
+            self.walk_count += 1  # dk: disable=DK202 - same lock, above
             for conn in self._conns:
                 self._disconnect(conn)
 
@@ -647,6 +689,76 @@ class PSClient:
         with self._fallback_lock:  # vs a concurrent fallback sweep
             self.shm_info = other.shm_info
         self._compute_stripes(template)
+
+    # -- self-tuning surface (netps/tuner/) ---------------------------------
+    def probe(self, arrays: Sequence[np.ndarray],
+              codec: Optional[str] = None) -> Optional[dict]:
+        """One timed micro-A/B round trip under ``codec`` (default: the
+        negotiated one): the payload travels and is decoded exactly like a
+        commit, but the server's ``probe`` op never touches the fold, the
+        journal, or the dedup table. Returns the reply header, or None
+        when the joined peer does not speak the probe dialect (no
+        ``tuner`` caps bit / codec not advertised) — old peers are left
+        alone by construction."""
+        caps = self.peer_caps or {}
+        if not caps.get("tuner"):
+            return None
+        use = codec if codec is not None else self.codec
+        if use != wire.CODEC_NONE and use not in caps.get("codecs", ()):
+            return None
+        items: list = []
+        for a in arrays:
+            a = np.ascontiguousarray(a, np.float32)
+            if use == wire.CODEC_NONE:
+                items.append(a)
+                continue
+            encoded, extras = wire.codec_encode(a, use)
+            items.append((encoded, extras) if extras else encoded)
+        hdr, _ = self._rpc(wire.OP_PROBE,
+                           self._stamped({"probe_codec": use}), items)
+        return hdr
+
+    def retune(self, codec: Optional[str] = None,
+               shards: Optional[int] = None,
+               template: Optional[Sequence[np.ndarray]] = None) -> dict:
+        """Adopt a new wire dialect MID-RUN through the same state the
+        join negotiation writes — membership, seq, epoch, and every
+        exactly-once guarantee are untouched (a retransmit after a retune
+        carries its original seq and dedups normally). Returns
+        ``{knob: (old, new)}`` of what actually changed; a codec the peer
+        never advertised or an out-of-range stripe count is clamped, not
+        an error. The caller must have quiesced its own in-flight commits
+        first (one logical commit must finish under ONE dialect)."""
+        caps = self.peer_caps or {}
+        changed: dict = {}
+        if codec is not None and codec != self.codec:
+            if codec == wire.CODEC_NONE or codec in caps.get("codecs", ()):
+                changed["codec"] = (self.codec, codec)
+                self.codec = codec
+                # The residual belongs to the old codec's lineage; error
+                # feedback restarts, exactly as on a rejoin.
+                self._residual = None
+                # Rejoins renegotiate from the retuned preference, not the
+                # construction-time one — a failover must not undo the
+                # controller's decision.
+                self.requested_codec = codec
+        if shards is not None:
+            want = max(1, min(int(shards), len(self._conns)))
+            if not caps.get("striping"):
+                want = 1
+            if want != self.active_shards:
+                changed["shards"] = (self.active_shards, want)
+                self.active_shards = want
+                self.shards = max(self.shards, want)
+                if template is not None:
+                    self._compute_stripes(template)
+                else:
+                    self._stripes = None
+                # The stripe pool is sized to active_shards; recreate lazily.
+                pool, self._pool = self._pool, None
+                if pool is not None:
+                    pool.shutdown(wait=True)
+        return changed
 
     def pull(self) -> tuple[list, int]:
         """Current center + update counter; renews the lease. An evicted

@@ -160,6 +160,18 @@ class AggregatorServer(PSServer):
         self._up.close()
 
     # ------------------------------------------------------------------
+    def set_fan_in(self, fan_in: Optional[int]) -> None:
+        """Retune the flush fan-in mid-run (the tuner's HIER lever):
+        ``None`` restores combine-the-full-membership; ``1`` degrades the
+        aggregator to a pass-through forwarder (flush per commit) — the
+        flat-topology behavior without tearing a single connection down.
+        Wakes the flusher so a now-satisfied window flushes immediately;
+        open-window accounting is untouched (exactly-once holds)."""
+        with self._flush_cv:
+            self.fan_in = fan_in
+            self._flush_cv.notify_all()
+
+    # ------------------------------------------------------------------
     def _fold_locked(self, wid: int, seq: int, pulled, delta: list) -> int:
         """Absorb one worker commit (lock held): decode wire-domain
         entries, add into the combined accumulator, take the min pull

@@ -61,6 +61,7 @@ from distkeras_tpu.netps.client import CommitResult
 from distkeras_tpu.netps.fold import check_discipline
 from distkeras_tpu.netps.shards import (is_sharded_endpoint, make_ps_client,
                                         plan_for_model)
+from distkeras_tpu.netps.tuner import Tuner, TunerState, autotune_enabled
 from distkeras_tpu.resilience import faults as _faults
 from distkeras_tpu.runtime import config
 
@@ -200,6 +201,7 @@ def run_remote(
     transport: Optional[str] = None,
     hier: Optional[bool] = None,
     hier_flush: Optional[float] = None,
+    autotune: Optional[bool] = None,
     loop_fn=None,
 ) -> tuple[Any, np.ndarray]:
     """Train ``plan.num_workers`` threads against the PS at ``endpoint``.
@@ -223,6 +225,14 @@ def run_remote(
     The first joiner seeds an uninitialized server with this model's
     params, so a bare ``python -m distkeras_tpu.netps`` server needs no
     model knowledge.
+
+    With ``autotune`` on (``DKTPU_NET_AUTOTUNE``), a :class:`~distkeras_
+    tpu.netps.tuner.controller.Tuner` closes the loop from the live
+    gauges to the knobs: join-time codec probes, mid-run inflight/codec/
+    striping retunes through :meth:`PSClient.retune`, and the HIER
+    topology by the measured fan-in crossover. Knobs the caller (or the
+    environment) pinned explicitly are respected as the starting point;
+    the controller's guardrails are documented in ``netps/tuner/``.
     """
     import jax
 
@@ -231,8 +241,31 @@ def run_remote(
 
     check_discipline(discipline)
     W = plan.num_workers
+    explicit_inflight = (inflight is not None
+                         or config.env_is_set("DKTPU_NET_INFLIGHT"))
     inflight = max(1, int(inflight if inflight is not None
                           else config.env_int("DKTPU_NET_INFLIGHT")))
+    autotune = (autotune_enabled() if autotune is None else bool(autotune))
+    tuner = None
+    if autotune:
+        # Explicit knobs win where set; the controller fills the rest.
+        # An unpinned inflight starts at 2 (the overlap window must exist
+        # before hidden_fraction can be measured) and the control loop
+        # walks it from there; an unpinned transport requests the ring
+        # (negotiated — cross-host pairs silently stay on TCP).
+        tuner = Tuner(W, inflight=inflight if explicit_inflight
+                      else max(inflight, 2))
+        inflight = tuner.inflight
+        if transport is None and not config.env_is_set("DKTPU_NET_TRANSPORT"):
+            transport = "shm"
+        if (shards is None and not config.env_is_set("DKTPU_NET_SHARDS")
+                and transport != "shm"):
+            # Striping headroom on TCP: connections are sized at
+            # construction, so a client that might be retuned UP to 2
+            # stripes mid-run needs 2 conns now (active stripes still
+            # start join-negotiated). The ring never stripes, so it
+            # keeps the single conn.
+            shards = 2
     elastic = discipline in ("aeasgd", "eamsgd")
     treedef = jax.tree.structure(model.params)
     init_leaves = _leaves(model.params)
@@ -266,6 +299,13 @@ def run_remote(
             "skew": round(shard_plan.skew(), 4)})
         client_kw["plan"] = shard_plan
     hier = (config.env_bool("DKTPU_NET_HIER") if hier is None else bool(hier))
+    if (tuner is not None and not hier
+            and not config.env_is_set("DKTPU_NET_HIER")):
+        # Nobody pinned the topology: pick it from the measured fan-in
+        # crossover (the bench hier_curve's break-even) — hierarchical
+        # combining only pays once this host's worker fan-in covers the
+        # aggregator's window cost.
+        hier = tuner.choose_topology() == "hier"
     agg = None
     worker_endpoint = endpoint
     if hier:
@@ -279,6 +319,8 @@ def run_remote(
             transport=transport, timeout=timeout, retries=retries,
             backoff=backoff, **agg_kw).start()
         worker_endpoint = agg.endpoint
+        if tuner is not None:
+            tuner.attach_aggregator(agg)
 
     def unflatten(leaves):
         return jax.tree.unflatten(treedef, [np.asarray(a) for a in leaves])
@@ -291,7 +333,11 @@ def run_remote(
         client = make_ps_client(worker_endpoint, worker_id=w, **client_kw)
         pull_client = None
         commit_lane = pull_lane = None
-        if inflight > 1:
+        # With the tuner aboard the lanes always exist — the controller
+        # may widen a serial (inflight=1) start into an overlapped one
+        # mid-run, and lanes cannot be conjured from inside the loop.
+        overlap = inflight > 1 or tuner is not None
+        if overlap:
             # Two comms lanes per worker: an ORDERED commit lane (seq order
             # is the exactly-once contract) and a pull-prefetch lane on its
             # own client/connections, so a slow commit cannot serialize the
@@ -302,7 +348,12 @@ def run_remote(
                 1, thread_name_prefix=f"netps-pull-{w}")
         try:
             center_leaves, counter = client.join(init=init_leaves)
-            if inflight > 1:
+            if tuner is not None and w == 0:
+                # The join-time micro A/B (one worker probes; the winner
+                # is published to everyone through the target generation).
+                tuner.startup(client, center_leaves)
+            tstate = TunerState()
+            if overlap:
                 pull_client = make_ps_client(worker_endpoint,
                                              worker_id=client.worker_id,
                                              **client_kw)
@@ -379,6 +430,23 @@ def run_remote(
                     if elastic:
                         local = unflatten(pulled_leaves)
                         opt_state = tx.init(local)
+                if tuner is not None:
+                    if w == 0:
+                        # Keep the overlap gauge live so the control loop
+                        # reads this run's evidence, not a stale export.
+                        meter.export()
+                        tuner.maybe_decide(r, client.active_transport)
+                    if tuner.generation != tstate.generation:
+                        # Quiesce the ordered lane before touching the
+                        # dialect: one logical commit finishes under ONE
+                        # codec/striping (exactly-once needs nothing more
+                        # — a retransmit keeps its seq either way).
+                        while pending:
+                            drain_one()
+                        changed = tuner.apply_to(client, pulled_leaves,
+                                                 tstate)
+                        if changed and pull_client is not None:
+                            pull_client.adopt_dialect(client, pulled_leaves)
                 start = local if elastic else unflatten(pulled_leaves)
                 xs, ys = _worker_round(plan, r, w)
                 rng = jax.random.fold_in(jax.random.fold_in(base_key, w), r)
@@ -398,7 +466,10 @@ def run_remote(
                     if discipline == "adag":
                         delta = [d / float(window) for d in delta]
                 if commit_lane is not None:
-                    while len(pending) >= inflight:
+                    # The tuner retargets the window mid-run; a narrowed
+                    # window simply drains deeper before the next submit.
+                    bound = tuner.inflight if tuner is not None else inflight
+                    while len(pending) >= max(1, bound):
                         drain_one()
                     fut = commit_lane.submit(
                         meter.timed, guarded_commit, delta, counter,
@@ -416,6 +487,10 @@ def run_remote(
                 losses[r, w] = float(np.mean(np.asarray(window_losses)))
             while pending:
                 drain_one()
+            if tuner is not None and w == 0:
+                # The converged dialect + decision counts, for the report
+                # and the bench's auto arm (read from the event stream).
+                tuner.export_summary(client)
             client.leave()
         except BaseException as e:  # noqa: BLE001 - surface on main thread
             errors.append(e)
@@ -442,7 +517,7 @@ def run_remote(
             # Flushes any half-accumulated combined commit upstream before
             # the final pull below reads the root's center.
             agg.close()
-    if inflight > 1:
+    if inflight > 1 or tuner is not None:
         # The gauge is OVERLAP evidence; the serial loop hides nothing by
         # construction, so exporting there would just report its absence.
         meter.export()

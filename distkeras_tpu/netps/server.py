@@ -579,6 +579,8 @@ class PSServer:
             return self._op_replicate(header)
         if op == "fence":
             return self._op_fence(header)
+        if op == wire.OP_PROBE:
+            return self._op_probe(header, arrays)
         return {"error": "protocol", "message": f"unknown op {op!r}"}, []
 
     @staticmethod
@@ -804,6 +806,37 @@ class PSServer:
                 # fails typed instead of assembling from two plans.
                 reply["plan_hash"] = self.shard_plan.plan_hash
             return reply, out
+
+    def _op_probe(self, header: dict, arrays: list) -> tuple[dict, list]:
+        """The tuner's timed micro-A/B round trip (``CAPS["tuner"]``): pay
+        the commit path's REAL decode cost — a quantized probe dequantizes
+        exactly like a quantized commit — but never touch the fold, the
+        journal, the dedup table, or membership. A probe can neither grant
+        a lease nor consume a seq, so it is invisible to every
+        exactly-once/fencing invariant."""
+        from distkeras_tpu import telemetry
+
+        t0 = time.monotonic()
+        try:
+            decoded = [np.asarray(decode_entry(a), np.float32)
+                       for a in arrays]
+        except (ProtocolError, TypeError, ValueError) as e:
+            return self._err("protocol", f"bad probe payload: {e}")
+        nbytes = sum(a.nbytes for a in decoded)
+        decode_s = time.monotonic() - t0
+        with self._lock:
+            err = self._check_primary_locked(header)
+            if err is not None:
+                return err
+            wid = header.get("worker_id")
+            if wid is not None and int(wid) in self._members:
+                # A member's probe renews its lease like any other round
+                # trip; a non-member probing (pre-join A/B) is fine too —
+                # probes never create membership.
+                self._members[int(wid)] = time.monotonic() + self.lease_s
+        telemetry.counter("netps.probes").add(1)
+        return {"ok": True, "probe_bytes": nbytes,
+                "decode_s": round(decode_s, 6)}, []
 
     def _op_commit(self, header: dict, arrays: list) -> tuple[dict, list]:
         from distkeras_tpu import telemetry

@@ -108,14 +108,24 @@ CODECS = (CODEC_NONE, CODEC_BF16, CODEC_INT8)
 #: plan hash — a PR 5-11 peer (no bit) or a plan-less same-build client
 #: gets a typed :class:`~distkeras_tpu.netps.errors.ShardPlanError` at
 #: join time instead of silently folding a partial plan.
+#: ``tuner`` advertises the ``probe`` op the self-tuning data plane's
+#: join-time micro A/B rides on (``netps/tuner/``): a timed round trip
+#: that is decoded like a commit but never touches the fold, journal, or
+#: dedup table. A peer without the bit answers the typed unknown-op
+#: error and the client's autotuner leaves it alone — old peers are
+#: unaffected by construction.
 CAPS = {"codecs": list(CODECS), "striping": True, "shm": True,
-        "replication": True, "serving": True, "sharding": True}
+        "replication": True, "serving": True, "sharding": True,
+        "tuner": True}
 
 #: serving-plane ops carried in ``header["op"]`` over the SAME frame
 #: format (length prefix, crc32, request-id echo) — the serving frontend
 #: speaks the wire protocol, not a second one.
 OP_INFER = "infer"
 OP_STATS = "stats"
+
+#: the tuner's timed micro-A/B round trip (see ``CAPS["tuner"]``).
+OP_PROBE = "probe"
 
 
 # ---------------------------------------------------------------------------

@@ -267,6 +267,50 @@ def shard_summary(summary: dict) -> Optional[dict]:
     return out or None
 
 
+def tuner_summary(records: list[dict], summary: dict) -> Optional[dict]:
+    """Roll up the self-tuning data plane's evidence: the decision log
+    (``tuner_decision`` events — knob, from→to, the gauge that triggered
+    it, the round it landed in), the join-time probe sweep
+    (``tuner_probe``), oscillation fallbacks (``tuner_fallback``), and
+    the converged dialect (``tuner_run_summary``). None when the run
+    never had the controller aboard (``DKTPU_NET_AUTOTUNE`` off)."""
+    counters = summary.get("counters", {})
+    decisions = [
+        {"knob": e.get("knob"), "from": e.get("from"), "to": e.get("to"),
+         "trigger": e.get("trigger"), "round": e.get("round")}
+        for e in records if e.get("kind") == "tuner_decision"]
+    probes = [
+        {"codec": e.get("codec"), "probes": e.get("probes"),
+         "seconds": e.get("seconds"), "score": e.get("score")}
+        for e in records if e.get("kind") == "tuner_probe"]
+    fallbacks = [
+        {"knob": e.get("knob"), "restored": e.get("restored"),
+         "round": e.get("round"), "reason": e.get("reason")}
+        for e in records if e.get("kind") == "tuner_fallback"]
+    converged = None
+    for e in records:
+        if e.get("kind") == "tuner_run_summary":
+            converged = {k: e.get(k) for k in
+                         ("inflight", "codec", "shards", "transport",
+                          "retunes", "fallbacks", "deferred")}
+    out: dict = {}
+    if decisions:
+        out["decisions"] = decisions
+    if probes:
+        out["probes"] = probes
+    if fallbacks:
+        out["fallbacks"] = fallbacks
+    if converged is not None:
+        out["converged"] = converged
+    for key, name in (("deferred", "tuner.deferred"),
+                      ("floor_violations", "tuner.floor_violations"),
+                      ("knob_warnings", "tuner.knob_warnings"),
+                      ("expand_blocked", "tuner.expand_blocked")):
+        if counters.get(name):
+            out[key] = counters[name]
+    return out or None
+
+
 def straggler_table(rounds: list[dict], k: float = STRAGGLER_K) -> list[dict]:
     """Rounds whose wall time exceeds ``k`` x the median round time (plus
     any rounds the live monitor already flagged). Burst-tail rounds
@@ -317,6 +361,7 @@ def build_report(path: str, k: float = STRAGGLER_K) -> dict:
         "fleet": fleet_attribution(merged),
         "serving": serving_summary(merged),
         "shards": shard_summary(merged),
+        "tuner": tuner_summary(records, merged),
         "losses": [r["loss"] for r in rounds if "loss" in r],
     }
 
@@ -435,6 +480,41 @@ def render_report(report: dict) -> str:
         if sh.get("partial_commits"):
             w(f"partial commits (reconciled): "
               f"{sh['partial_commits']:.0f}\n")
+
+    if report.get("tuner"):
+        tu = report["tuner"]
+        w("\n## Tuner\n")
+        conv = tu.get("converged")
+        if conv:
+            w(f"converged: codec={conv.get('codec')} "
+              f"inflight={conv.get('inflight')} "
+              f"shards={conv.get('shards')} "
+              f"transport={conv.get('transport')}   "
+              f"retunes: {conv.get('retunes', 0)}   "
+              f"fallbacks: {conv.get('fallbacks', 0)}   "
+              f"deferred: {conv.get('deferred', 0)}\n")
+        if tu.get("probes"):
+            w(f"{'probe codec':<12} {'probes':>7} {'seconds':>10} "
+              f"{'bytes/s':>14}\n")
+            for p in tu["probes"]:
+                w(f"{str(p['codec']):<12} {p['probes']:>7} "
+                  f"{p['seconds']:>10.4f} {p['score']:>14,.0f}\n")
+        if tu.get("decisions"):
+            w(f"{'round':>7} {'knob':<12} {'from':>8} {'to':>8} "
+              f"trigger\n")
+            for d in tu["decisions"]:
+                w(f"{d['round']:>7} {str(d['knob']):<12} "
+                  f"{str(d['from']):>8} {str(d['to']):>8} "
+                  f"{d['trigger']}\n")
+        for fb in tu.get("fallbacks", ()):
+            w(f"oscillation fallback: {fb['knob']} restored to "
+              f"{fb['restored']} at round {fb['round']} ({fb['reason']})\n")
+        for key, label in (("floor_violations", "floor violations"),
+                           ("knob_warnings", "knob warnings"),
+                           ("expand_blocked", "expansions blocked"),
+                           ("deferred", "deferred applies")):
+            if tu.get(key):
+                w(f"{label}: {tu[key]:.0f}\n")
 
     w("\n## Stragglers\n")
     if report["stragglers"]:

@@ -363,6 +363,31 @@ def main() -> int:
     for wid, seq, _st in server.commit_log:
         assert (wid, seq) not in seen, f"commit ({wid}, {seq}) folded twice"
         seen.add((wid, seq))
+    if config.env_bool("DKTPU_NET_AUTOTUNE"):
+        # The self-tuning data plane under chaos: the controller must have
+        # engaged (probes on TCP, the measured ring rule on shm, decisions
+        # either way) and every retune it issued must have respected the
+        # floors — a floor violation under faults means the guardrails,
+        # not the chaos, are the bug.
+        probes = reg.counter("tuner.probes").value
+        floor_violations = reg.counter("tuner.floor_violations").value
+        fallbacks = reg.counter("tuner.oscillation_fallbacks").value
+        decisions = reg.counter("tuner.decisions").value
+        runs = [e for e in reg.events() if e["kind"] == "tuner_run_summary"]
+        print(f"netps autotune under chaos: probes={probes:.0f} "
+              f"decisions={decisions:.0f} fallbacks={fallbacks:.0f} "
+              f"floor_violations={floor_violations:.0f} converged="
+              + (",".join(f"{k}={runs[-1].get(k)}" for k in
+                          ("transport", "codec", "shards", "inflight"))
+                 if runs else "none"))
+        assert runs, "autotune on but the controller never exported a summary"
+        assert decisions >= 1, "autotune on but the controller never decided"
+        if runs[-1].get("transport") == "tcp":
+            assert probes >= 1, (
+                "TCP data plane but the controller never probed a codec")
+        assert floor_violations == 0, (
+            f"the controller violated a knob floor {floor_violations:.0f} "
+            "times while retuning under chaos")
     return 0
 
 

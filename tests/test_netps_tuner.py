@@ -1,0 +1,390 @@
+"""The self-tuning data plane: join-time probes, guardrailed retunes,
+mid-run renegotiation, and the fleet's marginal-throughput expansion gate.
+
+The contract under test, in the module docstring of
+``netps/tuner/controller.py``: floors are never violated, the retune rate
+is bounded (interval/cooldown/budget), oscillation falls back to the
+static knobs, failover defers adoption rather than losing it — and a
+retune with commits in flight changes NOTHING about exactly-once (a
+retransmit keeps its seq and is answered by the dedup table either way).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.netps import PSClient, PSServer, wire
+from distkeras_tpu.netps.client import (
+    _BAD_KNOB_COMBOS_WARNED,
+    _validate_knob_combo,
+)
+from distkeras_tpu.netps.tuner import (
+    MarginalThroughputPolicy,
+    Tuner,
+    TunerConfig,
+    TunerState,
+    best_codec,
+    probe_codecs,
+    recommended_topology,
+)
+
+FAST = dict(timeout=1.0, retries=3, backoff=0.01)
+
+
+def make_server(**kw):
+    kw.setdefault("discipline", "adag")
+    return PSServer(**kw).start()
+
+
+def leaves(*shapes):
+    rng = np.random.default_rng(0)
+    return [rng.normal(size=s).astype(np.float32) for s in shapes]
+
+
+def cfg(**over):
+    """A deterministic unit-test TunerConfig (no env coupling)."""
+    base = dict(interval=1, cooldown=1, probes=1, max_retunes=8,
+                osc_limit=3, hier_fanin=4, min_gain=0.1,
+                hidden_floor=0.5, stale_ceiling=4.0)
+    base.update(over)
+    return TunerConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: knob-combo validation at client init
+# ---------------------------------------------------------------------------
+
+def test_measured_bad_knob_combo_warns_once_per_process():
+    _BAD_KNOB_COMBOS_WARNED.clear()
+    telemetry.reset()
+    with pytest.warns(RuntimeWarning, match="int8\\+shm"):
+        _validate_knob_combo("int8", "shm", 1)
+    with pytest.warns(RuntimeWarning, match="shards>1\\+shm"):
+        _validate_knob_combo("none", "shm", 2)
+    # Same combos again: silent (a fleet must not scream N times).
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        _validate_knob_combo("int8", "shm", 4)
+    reg = telemetry.get()
+    assert reg.counter("tuner.knob_warnings").value == 2
+    combos = [e["combo"] for e in reg.events()
+              if e["kind"] == "netps_knob_warning"]
+    assert combos == ["int8+shm", "shards>1+shm"]
+    # Measured-GOOD pairings never warn.
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        _validate_knob_combo("none", "shm", 1)
+        _validate_knob_combo("int8", "tcp", 4)
+
+
+def test_client_init_routes_through_combo_validation():
+    _BAD_KNOB_COMBOS_WARNED.clear()
+    srv = make_server()
+    try:
+        with pytest.warns(RuntimeWarning, match="int8\\+shm"):
+            c = PSClient(srv.endpoint, worker_id=0, compress="int8",
+                         transport="shm", **FAST)
+        c.close()
+    finally:
+        srv.close()
+    _BAD_KNOB_COMBOS_WARNED.clear()
+
+
+# ---------------------------------------------------------------------------
+# Join-time micro A/B probes
+# ---------------------------------------------------------------------------
+
+def test_probe_none_against_capability_less_server(monkeypatch):
+    """Old peers are unaffected by construction: no ``tuner`` caps bit
+    means no probe traffic at all — the sweep is empty, the static knobs
+    stand."""
+    monkeypatch.setattr(wire, "CAPS", {})
+    srv = make_server()
+    try:
+        with PSClient(srv.endpoint, worker_id=0, **FAST) as c:
+            init = leaves((8,))
+            c.join(init=init)
+            assert c.probe(init) is None
+            assert probe_codecs(c, init) == []
+            assert best_codec([]) is None
+    finally:
+        srv.close()
+
+
+def test_probe_pays_decode_but_never_touches_server_state():
+    """The probe op decodes exactly like a commit (the timing must include
+    the dequantize cost) but must not move the fold, the journal, the
+    dedup table, or the update counter."""
+    srv = make_server()
+    try:
+        with PSClient(srv.endpoint, worker_id=0, **FAST) as c:
+            init = leaves((16, 3), (5,))
+            _, upd = c.join(init=init)
+            assert c.commit([np.ones_like(a) for a in init], upd).applied
+            center_before, upd_before = c.pull()
+            log_before = list(srv.commit_log)
+            seq_before = dict(srv._last_seq)
+            for codec in wire.CODECS:
+                hdr = c.probe(init, codec=codec)
+                assert hdr is not None and hdr["ok"]
+                # probe_bytes is the LOGICAL f32 payload, codec-independent.
+                assert hdr["probe_bytes"] == sum(a.nbytes for a in init)
+                assert hdr["decode_s"] >= 0.0
+            center_after, upd_after = c.pull()
+            assert srv.commit_log == log_before
+            assert dict(srv._last_seq) == seq_before
+            assert upd_after == upd_before
+            for a, b in zip(center_before, center_after):
+                np.testing.assert_array_equal(a, b)
+    finally:
+        srv.close()
+
+
+def test_probe_sweep_scores_and_picks_a_winner():
+    telemetry.reset()
+    srv = make_server()
+    try:
+        with PSClient(srv.endpoint, worker_id=0, **FAST) as c:
+            init = leaves((64, 8))
+            c.join(init=init)
+            results = probe_codecs(c, init, probes=2)
+            assert [r.codec for r in results] == list(wire.CODECS)
+            assert all(r.score > 0 and r.probes == 2 for r in results)
+            assert best_codec(results) in wire.CODECS
+        reg = telemetry.get()
+        assert reg.counter("tuner.probes").value == 2 * len(wire.CODECS)
+        assert [e["codec"] for e in reg.events()
+                if e["kind"] == "tuner_probe"] == list(wire.CODECS)
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Mid-run renegotiation: exactly-once and torn-pull safety
+# ---------------------------------------------------------------------------
+
+def test_retune_with_commits_in_flight_preserves_exactly_once():
+    """THE mid-run retune acceptance scenario: a commit folded under the
+    old dialect is retransmitted AFTER the codec retune — the dedup table
+    answers it (duplicate, no second fold), and the next commit folds
+    normally under the new dialect."""
+    srv = make_server()
+    try:
+        with PSClient(srv.endpoint, worker_id=0, **FAST) as c:
+            init = [np.zeros(6, np.float32)]
+            _, upd = c.join(init=init)
+            assert c.commit([np.ones(6, np.float32)], upd).applied  # seq 0
+            changed = c.retune(codec="int8")
+            assert changed == {"codec": ("none", "int8")}
+            assert c._residual is None  # error feedback restarts
+            # The retransmit of seq 0 arrives after the retune (its reply
+            # was "lost"); it carries the ORIGINAL seq and dialect.
+            hdr, _ = c._rpc("commit", {"seq": 0, "pulled": 0},
+                            [np.ones(6, np.float32)])
+            assert hdr["duplicate"] is True
+            _, upd = c.pull()
+            assert c.commit([np.full(6, 2.0, np.float32)], upd).applied
+            assert [s for _w, s, _st in srv.commit_log] == [0, 1]
+            # One fold of +1.0 and one int8-quantized fold of ~+2.0.
+            np.testing.assert_allclose(srv.center()[0], 3.0, atol=0.05)
+    finally:
+        srv.close()
+
+
+def test_retune_survives_rejoin_with_the_retuned_preference():
+    """A failover/eviction rejoin renegotiates from the RETUNED codec,
+    not the construction-time one — a walk must not undo the controller."""
+    srv = make_server()
+    try:
+        with PSClient(srv.endpoint, worker_id=0, **FAST) as c:
+            init = leaves((4,))
+            c.join(init=init)
+            c.retune(codec="bf16")
+            assert c.requested_codec == "bf16"
+            c.join()  # an explicit rejoin renegotiates the dialect
+            assert c.codec == "bf16"
+    finally:
+        srv.close()
+
+
+def test_striping_retune_midrun_without_torn_pull():
+    """Flipping the stripe count mid-run: every pull before and after the
+    change reassembles the same center an unstriped observer sees, and
+    each logical commit still folds exactly once."""
+    srv = make_server(discipline="downpour")
+    try:
+        init = leaves((40, 3), (7,), (2, 2), (90,))
+        with PSClient(srv.endpoint, worker_id=0, shards=2, **FAST) as c, \
+                PSClient(srv.endpoint, worker_id=1, **FAST) as plain:
+            _, upd = c.join(init=init)
+            plain.join()
+            assert c.active_shards == 2
+            assert c.commit([np.ones_like(a) for a in init], upd).applied
+            changed = c.retune(shards=1, template=init)
+            assert changed == {"shards": (2, 1)}
+            striped_off, u1 = c.pull()
+            ref, u2 = plain.pull()
+            assert u1 == u2
+            for a, b in zip(striped_off, ref):
+                np.testing.assert_array_equal(a, b)
+            _, upd = c.pull()
+            assert c.commit([np.ones_like(a) for a in init], upd).applied
+            changed = c.retune(shards=2, template=init)
+            assert changed == {"shards": (1, 2)}
+            striped_on, u3 = c.pull()
+            ref, u4 = plain.pull()
+            assert u3 == u4
+            for a, b, i in zip(striped_on, ref, init):
+                np.testing.assert_array_equal(a, b)
+                np.testing.assert_allclose(a, i + 2.0, rtol=1e-6)
+        assert [(w, s) for w, s, _ in srv.commit_log] == [(0, 0), (0, 1)]
+    finally:
+        srv.close()
+
+
+def test_retune_clamps_unknown_codec_and_out_of_range_shards():
+    srv = make_server()
+    try:
+        with PSClient(srv.endpoint, worker_id=0, **FAST) as c:
+            init = leaves((4,))
+            c.join(init=init)
+            assert c.retune(codec="zstd") == {}  # never advertised
+            assert c.codec == "none"
+            # One connection: a 4-way stripe target clamps to 1 (no-op).
+            assert c.retune(shards=4, template=init) == {}
+            assert c.active_shards == 1
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Controller guardrails (pure unit tests — no server)
+# ---------------------------------------------------------------------------
+
+def test_apply_to_during_failover_walk_is_deferred_not_lost():
+    class FakeClient:
+        walk_count = 0
+
+        def __init__(self):
+            self.calls = []
+
+        def retune(self, codec=None, shards=None, template=None):
+            self.calls.append((codec, shards))
+            return {"codec": (None, codec)}
+
+    telemetry.reset()
+    t = Tuner(4, cfg=cfg())
+    assert t.propose("codec", "none", "int8", "test", 0)
+    assert t.generation == 1
+    fc, st = FakeClient(), TunerState()
+    fc.walk_count = 2  # the endpoint walker moved since st.walks == 0
+    assert t.apply_to(fc, [], st) is None
+    assert fc.calls == [] and st.generation == 0  # deferred...
+    assert t.deferred == 1
+    assert telemetry.get().counter("tuner.deferred").value == 1
+    # ...and retried next round (no further walk): the generation lands.
+    assert t.apply_to(fc, [], st) == {"codec": (None, "int8")}
+    assert fc.calls == [("int8", None)] and st.generation == 1
+    assert t.apply_to(fc, [], st) is None  # nothing left to adopt
+
+
+def test_floor_violating_proposal_is_dropped_and_counted():
+    telemetry.reset()
+    t = Tuner(4, inflight=2, cfg=cfg())
+    assert not t.propose("inflight", 2, 0, "test", 0)  # below floor
+    assert t.inflight == 2
+    t.peer_codecs = ("none", "bf16")
+    assert not t.propose("codec", "none", "int8", "test", 0)  # unadvertised
+    assert t.codec is None
+    assert telemetry.get().counter("tuner.floor_violations").value == 2
+
+
+def test_cooldown_and_budget_bound_the_retune_rate():
+    t = Tuner(4, inflight=1, cfg=cfg(cooldown=5, max_retunes=2))
+    assert t.propose("inflight", 1, 2, "test", 0)
+    assert not t.propose("inflight", 2, 3, "test", 2)   # inside cooldown
+    assert t.propose("inflight", 2, 3, "test", 5)       # budget now spent
+    assert not t.propose("inflight", 3, 4, "test", 20)  # over max_retunes
+    assert t.inflight == 3 and t.retunes == 2
+
+
+def test_oscillation_freezes_the_knob_at_its_static_initial():
+    telemetry.reset()
+    t = Tuner(4, inflight=1, cfg=cfg(osc_limit=2, max_retunes=100))
+    assert t.propose("inflight", 1, 2, "a", 0)
+    assert t.propose("inflight", 2, 1, "b", 10)   # flip 1
+    assert t.propose("inflight", 1, 2, "c", 20)   # flip 2 -> freeze
+    assert t.inflight == 1  # restored to the static initial
+    assert t.fallbacks == 1
+    assert not t.propose("inflight", 1, 3, "d", 40)  # frozen for the run
+    reg = telemetry.get()
+    assert reg.counter("tuner.oscillation_fallbacks").value == 1
+    falls = [e for e in reg.events() if e["kind"] == "tuner_fallback"]
+    assert len(falls) == 1 and falls[0]["knob"] == "inflight"
+    assert falls[0]["restored"] == 1
+
+
+def test_recommended_topology_flips_at_the_fan_in_crossover():
+    assert recommended_topology(3, crossover=4) == "flat"
+    assert recommended_topology(4, crossover=4) == "hier"
+    assert recommended_topology(2) == "flat"   # env default crossover: 4
+    assert recommended_topology(8) == "hier"
+    t = Tuner(8, cfg=cfg())
+    assert t.choose_topology() == "hier"
+    assert t.decisions[-1].knob == "topology"
+    assert t.decisions[-1].old is None  # chosen, not changed
+
+
+# ---------------------------------------------------------------------------
+# Fleet: marginal-throughput expansion gate
+# ---------------------------------------------------------------------------
+
+def test_marginal_throughput_policy_blocks_flat_growth():
+    telemetry.reset()
+    p = MarginalThroughputPolicy(min_gain=0.1)
+    assert p.allow_expand("t/j", 1)  # no evidence: never starve a cold job
+    p.observe("t/j", 1, 0, now=0.0)
+    p.observe("t/j", 1, 100, now=1.0)   # rate 100 at 1 worker
+    p.observe("t/j", 2, 100, now=1.0)   # grant grew: seal + re-anchor
+    p.observe("t/j", 2, 205, now=2.0)   # rate 105 at 2 workers
+    # 105 < 100 * 1.1: the second worker did not move the needle.
+    assert not p.allow_expand("t/j", 2)
+    reg = telemetry.get()
+    assert reg.counter("tuner.expand_blocked").value == 1
+    blocked = [e for e in reg.events() if e["kind"] == "tuner_expand_blocked"]
+    assert blocked and blocked[0]["job"] == "t/j"
+    # The rate recovers (straggler healed): expansion re-opens.
+    p.observe("t/j", 2, 350, now=3.0)   # rate 125 at 2 workers
+    assert p.allow_expand("t/j", 2)
+
+
+def test_scheduler_expansion_gate_holds_grant_without_floor_violations():
+    import test_fleet as tf
+    from distkeras_tpu.fleet import DONE, FleetJob, FleetScheduler
+
+    class Deny:
+        def __init__(self):
+            self.asked = []
+
+        def observe(self, label, workers, progress, now=None):
+            pass
+
+        def allow_expand(self, label, workers):
+            self.asked.append((label, workers))
+            return False
+
+    policy = Deny()
+    sched = FleetScheduler(capacity=4, tick_s=0.01,
+                           expansion_policy=policy)
+    job = sched.submit(FleetJob("solo", "a", tf.FakeRuntime(total=40),
+                                min_gang=1, max_workers=4))
+    tf.drive(sched, lambda: job.state == DONE)
+    sched.close()
+    # The gang floor was honored (the job ran and finished), expansion
+    # beyond it was withheld every tick, and withholding an EXPANSION can
+    # never read as a floor violation.
+    assert policy.asked and all(w >= 1 for _l, w in policy.asked)
+    assert job.expands == 0
+    assert sched.floor_violations == 0
