@@ -23,6 +23,7 @@ import time
 from typing import Optional, Sequence
 
 from distkeras_tpu.resilience.backoff import full_jitter
+from distkeras_tpu.telemetry import tracing
 
 
 @dataclasses.dataclass
@@ -261,6 +262,12 @@ class Job:
                 "JAX_NUM_PROCESSES": str(len(pc.hosts)),
                 "JAX_PROCESS_ID": str(i),
                 **({"DKTPU_PS_ENDPOINT": endpoint} if endpoint else {}),
+                # With tracing on, every child's spans/flight dumps carry
+                # a fleet-unique role label (workers here; the netps CLI
+                # self-labels ps/shardK/standby). Before ``pc.env`` so an
+                # operator's explicit label still wins.
+                **({"DKTPU_TRACE_ROLE": f"worker{i}"}
+                   if tracing.enabled() else {}),
                 **pc.env,
             }
             env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())

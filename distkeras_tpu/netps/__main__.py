@@ -36,6 +36,7 @@ import threading
 from distkeras_tpu.netps.fold import SUPPORTED_DISCIPLINES
 from distkeras_tpu.netps.server import PSServer
 from distkeras_tpu.runtime import config
+from distkeras_tpu.telemetry import tracing
 
 #: exit status of a second-signal forced abort (EX_SOFTWARE; distinct from
 #: both a clean drain's 0 and a SIGKILL's -9 so ``Job.supervise`` can tell
@@ -88,6 +89,16 @@ def main(argv=None) -> int:
               lease_s=args.lease, state_dir=state_dir,
               snapshot_every=args.snapshot_every,
               shard_index=shard_index, shard_count=shard_count)
+    # Label this process for the trace/flight streams (an explicit
+    # DKTPU_TRACE_ROLE — e.g. one the fleet launcher stamped — wins) and
+    # arm the crash-path flight-recorder dump before anything can fail.
+    if standby_of:
+        tracing.set_role("standby")
+    elif shard_index is not None:
+        tracing.set_role(f"shard{shard_index}")
+    else:
+        tracing.set_role("ps")
+    tracing.install_crash_hooks()
     if standby_of:
         from distkeras_tpu.netps.standby import StandbyServer
 
@@ -108,6 +119,11 @@ def main(argv=None) -> int:
             # "hung, escalate" without guessing.
             os.write(1, b"NETPS_DRAINING\n")
             stop.set()
+            # Dump the flight ring while the process is still healthy —
+            # the drain may take seconds and a second signal force-exits
+            # without running atexit. No-op with tracing off; dedup'd per
+            # reason, so a SIGTERM storm writes the ring once.
+            tracing.flight_dump("sigterm")
         else:
             # A second signal mid-drain means the operator (or Job.kill's
             # escalation) wants OUT — force-exit nonzero rather than
@@ -125,6 +141,21 @@ def main(argv=None) -> int:
             announced = True
             print(f"NETPS_PROMOTED epoch={server.epoch}", flush=True)
     server.close()
+    trace_d = tracing.trace_dir()
+    if trace_d:
+        # Final telemetry dump beside the trace stream: the collector
+        # merges this process's counters/events into the fleet timeline.
+        from distkeras_tpu import telemetry
+
+        try:
+            os.makedirs(trace_d, exist_ok=True)
+            telemetry.write_jsonl(
+                telemetry.get(),
+                os.path.join(trace_d,
+                             f"telemetry-{tracing.role()}-{os.getpid()}"
+                             ".jsonl"))
+        except OSError:
+            pass
     print(f"NETPS_DRAINED commits={server.commits_total} "
           f"epoch={server.epoch} snapshots={server.snapshots_written} "
           f"evictions={server.evictions} rejoins={server.rejoins}",

@@ -77,6 +77,8 @@ from distkeras_tpu.netps.errors import (
 )
 from distkeras_tpu.resilience.backoff import full_jitter
 from distkeras_tpu.runtime import config
+from distkeras_tpu.telemetry import tracing
+from distkeras_tpu.telemetry.tracing import clock as _traceclock
 
 #: server error kind -> typed exception. Everything here except
 #: ``not_primary`` is NON-retryable: the server answered, it just said no.
@@ -574,6 +576,41 @@ class PSClient:
             header["epoch"] = self.epoch
         return header
 
+    # -- distributed tracing (telemetry/tracing/) ----------------------------
+    def _trace_peer(self) -> bool:
+        """Whether the joined peer advertised ``CAPS["tracing"]`` — the
+        gate on every trace/clock header field. A peer that never said the
+        bit is sent zero new bytes (absent JSON key = absent wire byte)."""
+        return bool((self.peer_caps or {}).get("tracing"))
+
+    def _traced(self, header: dict) -> dict:
+        """Attach the ambient trace context to an outgoing header (no-op
+        with tracing off, outside any scope, or against an untraced peer)."""
+        if self._trace_peer():
+            header.update(tracing.wire_fields())
+        return header
+
+    def _rpc_traced(self, ctx, op: str, header: dict, arrays: Sequence = (),
+                    conn_idx: int = 0) -> tuple[dict, list]:
+        """One stripe sub-RPC under the captured trace context: pool
+        threads do not inherit thread-locals, so the fan-out captures the
+        commit/pull root and re-establishes it here, giving every stripe
+        its own ``<op>.wire`` child span carrying the wire fields."""
+        with tracing.adopt(ctx):
+            with tracing.child_scope(f"{op}.wire",
+                                     shard=header.get("shard")):
+                return self._rpc(op, self._traced(header), arrays, conn_idx)
+
+    def _clock_stamp(self, header: dict):
+        """Stamp ``ct0`` (this clock's send time) for the NTP-style
+        exchange — only against a peer that already proved it speaks the
+        tracing dialect. Returns the stamp for :func:`observe_reply`."""
+        if not (tracing.enabled() and self._trace_peer()):
+            return None
+        ct0 = time.time()
+        header["ct0"] = ct0
+        return ct0
+
     # -- striping helpers ---------------------------------------------------
     def _compute_stripes(self, template: Sequence[np.ndarray]) -> None:
         """Byte-balanced greedy stripe assignment of tensor indices over the
@@ -628,9 +665,15 @@ class PSClient:
         (codec + striping) for every later pull/commit. ``_join_extra``
         fields (the sharded client's shard identity + plan) ride on every
         join, auto-rejoins included."""
-        hdr, center = self._rpc("join",
-                                dict(self._join_extra, caps=wire.CAPS),
-                                list(init or ()))
+        join_hdr = dict(self._join_extra, caps=wire.CAPS)
+        # The clock exchange rides only once the peer has PROVED the
+        # tracing dialect (a previous join's caps) — the first join of a
+        # fresh client stays byte-identical to an untraced one; rejoins
+        # and heartbeats carry the estimate forward.
+        ct0 = self._clock_stamp(join_hdr)
+        hdr, center = self._rpc("join", join_hdr, list(init or ()))
+        if ct0 is not None:
+            _traceclock.observe_reply(ct0, hdr, time.time())
         self.worker_id = int(hdr["worker_id"])
         self.lease_s = hdr.get("lease_s")
         # A join ADOPTS the server's epoch (a failover re-join is exactly
@@ -767,12 +810,17 @@ class PSClient:
         concurrent fold are detected via the echoed counters and
         re-pulled)."""
         try:
-            if self._striped():
-                return self._striped_pull()
-            hdr, center = self._rpc("pull", self._stamped({}))
-        except (LeaseExpiredError, EpochFencedError):
+            with tracing.trace_scope("pull", wid=self.worker_id):
+                if self._striped():
+                    return self._striped_pull()
+                with tracing.child_scope("pull.wire"):
+                    hdr, center = self._rpc(
+                        "pull", self._traced(self._stamped({})))
+        except (LeaseExpiredError, EpochFencedError) as e:
             # Fenced reads exactly like evicted: the old lineage is gone;
             # re-join (walking to the promoted primary) and adopt.
+            if isinstance(e, EpochFencedError):
+                tracing.flight_dump("epoch_fenced")
             if not self.auto_rejoin:
                 raise
             self.rejoin_count += 1
@@ -787,9 +835,10 @@ class PSClient:
         pool = self._shard_pool()
         stripes = self._stripes
         total = sum(len(s) for s in stripes)
+        ctx = tracing.current()
         for _ in range(_PULL_CONSISTENT_TRIES):
             futures = [
-                pool.submit(self._rpc, "pull",
+                pool.submit(self._rpc_traced, ctx, "pull",
                             self._stamped({"shard": s,
                                            "num_shards": len(stripes),
                                            "idx": idx}), (), s)
@@ -848,43 +897,57 @@ class PSClient:
         else:
             self._seq = max(self._seq, int(seq))
             seq = int(seq)
-        items = self._compress_delta(delta)
-        base = self._stamped({"seq": seq, "pulled": int(pulled_counter)})
-        try:
-            if self._striped() and len(items) == sum(
-                    len(s) for s in self._stripes):
-                hdr = self._striped_commit(base, items)
-            else:
-                hdr, _ = self._rpc("commit", base, items)
-        except (LeaseExpiredError, EpochFencedError):
-            # Fenced commit = evicted commit: it was NEVER folded (the
-            # whole point of the fence); discard the window, re-join the
-            # promoted primary, continue from a fresh pull.
-            if not self.auto_rejoin:
-                raise
-            self.rejoin_count += 1
-            self.join()
-            return CommitResult(applied=False, duplicate=False, evicted=True,
-                                updates=-1, staleness=-1)
-        if hdr is None:
-            # Every stripe answered ``pending``: membership churn (an
-            # eviction sweep or a concurrent rejoin purging the server's
-            # half-assembled stripe set) lost this commit — it was NEVER
-            # folded and never will be. Same recovery as an evicted
-            # commit: discard the window, refresh membership + the
-            # server's pending state, continue from a fresh pull.
-            if not self.auto_rejoin:
-                raise NetPSError(
-                    "striped commit never completed: every stripe is "
-                    "pending — the server lost part of the stripe set")
-            self.join()
-            return CommitResult(applied=False, duplicate=False, evicted=True,
-                                updates=-1, staleness=-1)
-        return CommitResult(
-            applied=bool(hdr.get("applied")),
-            duplicate=bool(hdr.get("duplicate")),
-            evicted=False, updates=int(hdr["updates"]),
-            staleness=int(hdr.get("staleness", -1)))
+        # The trace root: one commit = one trace, client-rooted. Segments
+        # recorded here (encode/wire/ack) and on every process the wire
+        # fields reach (queue/fold/fsync/replicate) share its trace id.
+        with tracing.trace_scope("commit", wid=self.worker_id, seq=seq):
+            with tracing.child_scope("commit.encode"):
+                items = self._compress_delta(delta)
+            base = self._stamped({"seq": seq, "pulled": int(pulled_counter)})
+            try:
+                if self._striped() and len(items) == sum(
+                        len(s) for s in self._stripes):
+                    hdr = self._striped_commit(base, items)
+                else:
+                    with tracing.child_scope("commit.wire"):
+                        hdr, _ = self._rpc("commit", self._traced(base),
+                                           items)
+            except (LeaseExpiredError, EpochFencedError) as e:
+                # Fenced commit = evicted commit: it was NEVER folded (the
+                # whole point of the fence); discard the window, re-join
+                # the promoted primary, continue from a fresh pull. A
+                # fence is flight-recorder evidence: dump the discarded
+                # lineage's last seconds before rejoining past it.
+                if isinstance(e, EpochFencedError):
+                    tracing.flight_dump("epoch_fenced")
+                if not self.auto_rejoin:
+                    raise
+                self.rejoin_count += 1
+                self.join()
+                return CommitResult(applied=False, duplicate=False,
+                                    evicted=True, updates=-1, staleness=-1)
+            if hdr is None:
+                # Every stripe answered ``pending``: membership churn (an
+                # eviction sweep or a concurrent rejoin purging the
+                # server's half-assembled stripe set) lost this commit —
+                # it was NEVER folded and never will be. Same recovery as
+                # an evicted commit: discard the window, refresh
+                # membership + the server's pending state, continue from
+                # a fresh pull.
+                if not self.auto_rejoin:
+                    raise NetPSError(
+                        "striped commit never completed: every stripe is "
+                        "pending — the server lost part of the stripe set")
+                self.join()
+                return CommitResult(applied=False, duplicate=False,
+                                    evicted=True, updates=-1, staleness=-1)
+            with tracing.child_scope("commit.ack",
+                                     applied=bool(hdr.get("applied"))):
+                return CommitResult(
+                    applied=bool(hdr.get("applied")),
+                    duplicate=bool(hdr.get("duplicate")),
+                    evicted=False, updates=int(hdr["updates"]),
+                    staleness=int(hdr.get("staleness", -1)))
 
     def _striped_commit(self, base: dict, items: list) -> Optional[dict]:
         """One logical commit over the stripe connections; returns the
@@ -893,9 +956,10 @@ class PSClient:
         :meth:`commit` recovers via the evicted path)."""
         stripes = self._stripes
         pool = self._shard_pool()
+        ctx = tracing.current()
         futures = [
             pool.submit(
-                self._rpc, "commit",
+                self._rpc_traced, ctx, "commit",
                 dict(base, shard=s, num_shards=len(stripes), idx=idx),
                 [items[i] for i in idx], s)
             for s, idx in enumerate(stripes)]
@@ -912,16 +976,33 @@ class PSClient:
         return None
 
     def heartbeat(self) -> int:
-        """Renew the lease; returns the server's update counter."""
+        """Renew the lease; returns the server's update counter. A traced
+        heartbeat doubles as the clock exchange's steady drumbeat — every
+        renewal is another four-timestamp sample, and the min-rtt one
+        wins."""
+        hb = self._stamped({})
+        ct0 = self._clock_stamp(hb)
         try:
-            hdr, _ = self._rpc("heartbeat", self._stamped({}))
-        except (LeaseExpiredError, EpochFencedError):
+            hdr, _ = self._rpc("heartbeat", hb)
+        except (LeaseExpiredError, EpochFencedError) as e:
+            if isinstance(e, EpochFencedError):
+                tracing.flight_dump("epoch_fenced")
             if not self.auto_rejoin:
                 raise
             self.rejoin_count += 1
             _center, updates = self.join()
             return updates
+        if ct0 is not None:
+            _traceclock.observe_reply(ct0, hdr, time.time())
         return int(hdr["updates"])
+
+    def stats(self, ring: int = 64) -> dict:
+        """One live telemetry scrape of the peer (``CAPS`` op ``stats``):
+        counters/gauges/span aggregates plus the flight ring's most recent
+        ``ring`` records. Membership-free — no join, no lease, no seq —
+        so any observer (the ``telemetry scrape`` CLI) can dial in."""
+        hdr, _ = self._rpc(wire.OP_STATS, {"ring": int(ring)})
+        return hdr
 
     def leave(self) -> None:
         """Best-effort clean departure (a dead server is not an error —

@@ -52,6 +52,7 @@ from distkeras_tpu.netps.fold import check_discipline, decode_entry
 from distkeras_tpu.netps.server import PSServer
 from distkeras_tpu.netps.shards import make_ps_client
 from distkeras_tpu.runtime import config
+from distkeras_tpu.telemetry import tracing
 
 
 def _counter_scalar(updates) -> int:
@@ -121,6 +122,10 @@ class AggregatorServer(PSServer):
         #: counts members heard from, not commits (an overlapping worker
         #: can land 2 commits while others landed none).
         self._acc_members: set = set()
+        #: constituent trace ids of the open window (traced commits only):
+        #: the flush's ``hier.flush`` span links them, so a worker's
+        #: commit trace connects to the combined upstream commit's.
+        self._acc_traces: list = []
         self._acc_t0 = 0.0
         self._flush_cv = threading.Condition(self._lock)
         self._flusher_thread: Optional[threading.Thread] = None
@@ -180,15 +185,20 @@ class AggregatorServer(PSServer):
         NOT touch the center (the root owns it)."""
         pulled = int(pulled)
         staleness = self._updates - pulled
-        dec = [np.asarray(decode_entry(e), np.float32) for e in delta]
-        if self._acc is None:
-            self._acc = [a.copy() for a in dec]
-            self._acc_pulled = pulled
-            self._acc_t0 = time.monotonic()
-        else:
-            for acc, a in zip(self._acc, dec):
-                acc += a
-            self._acc_pulled = min(self._acc_pulled, pulled)
+        with tracing.child_scope("commit.fold", wid=wid, seq=seq,
+                                 hier=True):
+            dec = [np.asarray(decode_entry(e), np.float32) for e in delta]
+            if self._acc is None:
+                self._acc = [a.copy() for a in dec]
+                self._acc_pulled = pulled
+                self._acc_t0 = time.monotonic()
+            else:
+                for acc, a in zip(self._acc, dec):
+                    acc += a
+                self._acc_pulled = min(self._acc_pulled, pulled)
+        ctx = tracing.current()
+        if ctx is not None and len(self._acc_traces) < 64:
+            self._acc_traces.append(ctx.trace)
         self._acc_count += 1
         self._acc_members.add(wid)
         self.absorbed += 1
@@ -213,11 +223,12 @@ class AggregatorServer(PSServer):
                 and age < self.flush_interval):
             return None
         taken = (self._acc, self._acc_pulled, self._acc_count,
-                 len(self._acc_members))
+                 len(self._acc_members), self._acc_traces)
         self._acc = None
         self._acc_pulled = None
         self._acc_count = 0
         self._acc_members = set()
+        self._acc_traces = []
         return taken
 
     def _lose_window(self) -> None:
@@ -240,9 +251,14 @@ class AggregatorServer(PSServer):
             taken = self._take_acc_locked(force)
         if taken is None:
             return False
-        acc, pulled, count, members = taken
+        acc, pulled, count, members, traces = taken
         try:
-            res = self._up.commit(acc, pulled)
+            # The combined commit gets its own trace, LINKING the
+            # constituent worker traces (a fan-in is a DAG, not a tree —
+            # links are how one upstream fold connects to N origins).
+            with tracing.trace_scope("hier.flush", count=count,
+                                     links=traces[:16]):
+                res = self._up.commit(acc, pulled)
         except (NetPSError, OSError):
             # Past the client's own retry budget: the combined window died
             # in flight — the flat topology's lost-commit semantics, one

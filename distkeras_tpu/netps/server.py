@@ -60,6 +60,7 @@ from distkeras_tpu.netps.fold import (check_discipline, decode_entry,
                                       validate_delta)
 from distkeras_tpu.resilience import faults as _faults
 from distkeras_tpu.runtime import config
+from distkeras_tpu.telemetry import tracing as _tracing
 
 #: handler/accept poll tick: how often blocked threads wake to check stop.
 _POLL_S = 0.2
@@ -516,10 +517,18 @@ class PSServer:
             return None
         telemetry.counter("netps.bytes_received").add(nbytes)
         op = header.get("op", "")
+        # Clock + trace plumbing, both strictly echo-shaped: ``st1``/
+        # ``st2`` are answered ONLY when the request stamped ``ct0`` (the
+        # NTP-style exchange), and the trace context exists ONLY when the
+        # request carried ``trace`` — an untraced peer sees zero new
+        # bytes in either direction.
+        st1 = time.time() if "ct0" in header else None
+        tctx = _tracing.header_ctx(header)
         if op == "commit":
             self._chaos_hooks()
         with telemetry.span(f"netps.server.{op or 'unknown'}{dialect}"):
-            reply, out = self._dispatch(op, header, arrays)
+            with _tracing.adopt(tctx):
+                reply, out = self._dispatch(op, header, arrays)
         err = reply.get("error")
         if op == "commit" and err == "epoch_fenced":
             # The zero-stale-epoch-folds evidence: every fenced commit is
@@ -533,6 +542,9 @@ class PSServer:
         if self._store is not None and op in ("commit", "join"):
             telemetry.gauge("netps.recovery.snapshots").set(
                 float(self.snapshots_written))
+        if st1 is not None:
+            reply["st1"] = st1
+            reply["st2"] = time.time()
         reply["req"] = header.get("req")
         return reply, out
 
@@ -581,6 +593,8 @@ class PSServer:
             return self._op_fence(header)
         if op == wire.OP_PROBE:
             return self._op_probe(header, arrays)
+        if op == wire.OP_STATS:
+            return self._op_stats(header)
         return {"error": "protocol", "message": f"unknown op {op!r}"}, []
 
     @staticmethod
@@ -861,7 +875,15 @@ class PSServer:
         except ProtocolError as e:
             telemetry.counter("netps.protocol_errors").add(1)
             return self._err("protocol", str(e))
+        # Queue-behind-fold: the wait for the center lock is the commit
+        # path's contention segment — measured around the acquire (a
+        # scope cannot wrap it) and emitted as a child of the request's
+        # carried context (no-op untraced).
+        tctx = _tracing.current()
+        q_wall, q0 = time.time(), time.perf_counter()
         with self._lock:
+            _tracing.emit("commit.queue", tctx, q_wall,
+                          time.perf_counter() - q0, wid=wid, seq=seq)
             err = self._check_primary_locked(header)
             if err is not None:
                 return err
@@ -913,7 +935,9 @@ class PSServer:
         buffer, and the commit-log bound."""
         staleness = self._updates - int(pulled)
         t0 = time.perf_counter()
-        fold_delta(self._center, delta, self.discipline, staleness)
+        with _tracing.child_scope("commit.fold", wid=wid, seq=seq,
+                                  staleness=staleness):
+            fold_delta(self._center, delta, self.discipline, staleness)
         self._fold_stats = (len(delta), time.perf_counter() - t0)
         u = self._updates
         self.commit_log.append((wid, seq, staleness))
@@ -924,17 +948,24 @@ class PSServer:
         if self._repl_on:
             # Wire-form tail for the standby's `replicate` pulls. Entries
             # keep their frame buffers alive (bounded by the deque).
-            self._repl.append({"u": u, "wid": wid, "seq": seq,
-                               "st": staleness, "e": self.epoch,
-                               "n": self.commits_total,
-                               "delta": list(delta)})
+            rec = {"u": u, "wid": wid, "seq": seq,
+                   "st": staleness, "e": self.epoch,
+                   "n": self.commits_total,
+                   "delta": list(delta)}
+            ctx = _tracing.current()
+            if ctx is not None:
+                # The tail carries the trace id so the standby's apply
+                # span joins the originating commit's trace.
+                rec["tr"] = ctx.trace
+            self._repl.append(rec)
         if self._store is not None:
-            self._store.append(epoch=self.epoch, wid=wid, seq=seq,
-                               staleness=staleness, updates=u,
-                               commits_total=self.commits_total,
-                               delta=delta)
-            if self._store.due(self._updates):
-                self._snapshot_locked()
+            with _tracing.child_scope("commit.fsync", wid=wid, seq=seq):
+                self._store.append(epoch=self.epoch, wid=wid, seq=seq,
+                                   staleness=staleness, updates=u,
+                                   commits_total=self.commits_total,
+                                   delta=delta)
+                if self._store.due(self._updates):
+                    self._snapshot_locked()
         # Hard bound between snapshots (or without a store at all): a
         # month-long run must not grow an unbounded evidence list.
         self._trim_log_locked(2 * self._log_keep)
@@ -1050,6 +1081,33 @@ class PSServer:
                 self._members.pop(int(wid), None)
         return {"ok": True}, []
 
+    def _op_stats(self, header: dict) -> tuple[dict, list]:
+        """Live telemetry scrape over the wire (``python -m
+        distkeras_tpu.telemetry scrape host:port``): the process's
+        counters/gauges/span aggregates plus the flight ring's most
+        recent records, with ``caps`` echoed so an observer can probe
+        capabilities without joining. Deliberately NOT behind the primary
+        check — a standby or fenced ex-primary is exactly the process a
+        postmortem wants to scrape — and it never touches membership,
+        leases, the dedup table, or the fold."""
+        from distkeras_tpu import telemetry
+        from distkeras_tpu.telemetry.tracing import ring_head
+
+        n = max(0, int(header.get("ring", 64) or 0))
+        with self._lock:
+            extra = {"updates": self._updates, "epoch": self.epoch,
+                     "members": len(self._members),
+                     "commits_total": self.commits_total,
+                     "draining": self._draining}
+        # The ring rides the JSON header: round-trip through json with a
+        # str fallback first — event fields may carry non-JSON scalars,
+        # and a scrape must never poison the reply frame.
+        ring = json.loads(json.dumps(ring_head(n), default=str))
+        return ({"ok": True, "caps": dict(wire.CAPS),
+                 "role": _tracing.role(),
+                 "snapshot": telemetry.get().snapshot(),
+                 "ring": ring, **extra}, [])
+
     def _op_replicate(self, header: dict) -> tuple[dict, list]:
         """One pull of the journal stream by a warm standby: ``u`` is the
         next fold index the standby needs. Answers a batch of journal
@@ -1088,9 +1146,14 @@ class PSServer:
                                     for k, v in self._last_seq.items()}}
                 return hdr, [a.copy() for a in self._center]
             recs = recs[:_REPL_BATCH]
-            headers = [{"u": r["u"], "wid": r["wid"], "seq": r["seq"],
-                        "st": r["st"], "e": r["e"], "n": r["n"],
-                        "k": len(r["delta"])} for r in recs]
+            headers = []
+            for r in recs:
+                h = {"u": r["u"], "wid": r["wid"], "seq": r["seq"],
+                     "st": r["st"], "e": r["e"], "n": r["n"],
+                     "k": len(r["delta"])}
+                if "tr" in r:
+                    h["tr"] = r["tr"]
+                headers.append(h)
             out: list = []
             for r in recs:
                 out.extend(r["delta"])

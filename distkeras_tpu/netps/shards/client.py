@@ -46,6 +46,7 @@ from distkeras_tpu.netps import wire
 from distkeras_tpu.netps.client import CommitResult, PSClient
 from distkeras_tpu.netps.errors import ShardPlanError
 from distkeras_tpu.netps.shards.plan import PartitionPlan, plan_for_model
+from distkeras_tpu.telemetry import tracing
 
 
 def is_sharded_endpoint(endpoint: str) -> bool:
@@ -133,11 +134,21 @@ class ShardedPSClient:
         self.close()
 
     # -- fan-out plumbing ----------------------------------------------
+    @staticmethod
+    def _run_adopted(ctx, fn):
+        """One fan-out leg under the caller's trace context (pool threads
+        do not inherit thread-locals; each sub-client's own spans then
+        join the logical operation's trace instead of rooting orphans)."""
+        with tracing.adopt(ctx):
+            return fn()
+
     def _fan(self, fns) -> list:
         """Run one callable per shard concurrently; wait for ALL, then
         re-raise the first failure (everything drained — no sub-client is
         left with an in-flight reply)."""
-        futures = [self._pool.submit(fn) for fn in fns]
+        ctx = tracing.current()
+        futures = [self._pool.submit(self._run_adopted, ctx, fn)
+                   for fn in fns]
         results, errors = [], []
         for f in futures:
             try:
@@ -257,6 +268,11 @@ class ShardedPSClient:
         if self.plan is None:
             self._fetch_plan()
 
+        with tracing.trace_scope("pull", wid=self.worker_id,
+                                 shards=len(self._subs)):
+            return self._pull_traced()
+
+    def _pull_traced(self) -> tuple[list, tuple]:
         def pull_one(k: int):
             sub = self._subs[k]
             out = sub.pull()
@@ -280,8 +296,6 @@ class ShardedPSClient:
         folded; a shard that evicted us gets one same-seq retransmit after
         its auto-rejoin, and an unreconciled shard surfaces the whole
         commit as ``evicted`` (discard the window, pull fresh)."""
-        from distkeras_tpu import telemetry
-
         if self.plan is None:
             raise ShardPlanError("commit before join: no plan")
         with self._lock:
@@ -295,6 +309,16 @@ class ShardedPSClient:
                     f"shards")
         else:
             pulled = [int(pulled_counter)] * len(self._subs)
+        # The logical commit's trace root: every shard's sub-commit (and
+        # every segment it fans into on the shard servers) joins this one
+        # trace via the _fan adoption.
+        with tracing.trace_scope("commit", wid=self.worker_id, seq=seq,
+                                 shards=len(self._subs)):
+            return self._commit_traced(delta, pulled, seq)
+
+    def _commit_traced(self, delta, pulled, seq) -> CommitResult:
+        from distkeras_tpu import telemetry
+
         slices = self.plan.scatter(list(delta))
 
         def commit_one(k: int) -> CommitResult:

@@ -58,6 +58,7 @@ from distkeras_tpu.netps import wire
 from distkeras_tpu.netps.errors import ProtocolError
 from distkeras_tpu.netps.fold import decode_entry, fold_delta
 from distkeras_tpu.netps.server import PSServer
+from distkeras_tpu.telemetry import tracing
 
 
 
@@ -267,6 +268,7 @@ class StandbyServer(PSServer):
         bookkeeping the primary's fold ran, including the standby's own
         journal so a promoted-then-restarted standby recovers."""
         wid, seq, st = int(rec["wid"]), int(rec["seq"]), int(rec["st"])
+        t0, p0 = time.time(), time.perf_counter()
         fold_delta(self._center, delta, self.discipline, st)
         self.commit_log.append((wid, seq, st))
         self._last_seq[wid] = seq
@@ -282,6 +284,15 @@ class StandbyServer(PSServer):
             if self._store.due(self._updates):
                 self._snapshot_locked()
         self._trim_log_locked(2 * self._log_keep)
+        if rec.get("tr"):
+            # The journal record carried the originating commit's trace id
+            # (``tr``) across the replication stream: this span joins that
+            # trace directly, closing the commit→standby leg of the
+            # critical path. An empty parent is deliberate — the client's
+            # span ids never cross the replicate link, only the trace does.
+            tracing.emit("commit.replicate",
+                         tracing.TraceContext(str(rec["tr"]), ""),
+                         t0, time.perf_counter() - p0, wid=wid, seq=seq)
 
     # ------------------------------------------------------------------
     def _promote(self) -> None:

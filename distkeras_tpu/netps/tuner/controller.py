@@ -382,8 +382,12 @@ class Tuner:
                 self.deferred += 1
             telemetry.counter("tuner.deferred").add(1)
             return None
-        changed = client.retune(codec=codec, shards=shards,
-                                template=template)
+        from distkeras_tpu.telemetry import tracing
+
+        with tracing.trace_scope("tuner.retune", generation=gen,
+                                 codec=codec, shards=shards):
+            changed = client.retune(codec=codec, shards=shards,
+                                    template=template)
         state.generation = gen
         return changed
 

@@ -114,9 +114,17 @@ CODECS = (CODEC_NONE, CODEC_BF16, CODEC_INT8)
 #: dedup table. A peer without the bit answers the typed unknown-op
 #: error and the client's autotuner leaves it alone — old peers are
 #: unaffected by construction.
+#: ``tracing`` advertises distributed-trace context propagation
+#: (``telemetry/tracing/``): with ``DKTPU_TRACE=1`` a client adds
+#: ``trace``/``parent`` ids (and the NTP-style ``ct0`` clock-exchange
+#: timestamp on join/heartbeat) to request headers — but ONLY after the
+#: peer's caps carried the bit, so a peer without it sees zero new bytes
+#: on the wire; the server likewise answers the clock fields only on
+#: requests that carried ``ct0``. JSON headers make the gate structural:
+#: an absent key is an absent byte.
 CAPS = {"codecs": list(CODECS), "striping": True, "shm": True,
         "replication": True, "serving": True, "sharding": True,
-        "tuner": True}
+        "tuner": True, "tracing": True}
 
 #: serving-plane ops carried in ``header["op"]`` over the SAME frame
 #: format (length prefix, crc32, request-id echo) — the serving frontend

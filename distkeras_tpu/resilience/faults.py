@@ -196,9 +196,14 @@ class FaultPlan:
             with open(self.state_file, "a") as f:
                 f.write(f"{kind}@{at}\n")
         from distkeras_tpu import telemetry
+        from distkeras_tpu.telemetry import tracing
 
         telemetry.counter("resilience.faults_injected").add(1)
         telemetry.event("fault_injected", {"fault": kind, "at": at})
+        # Dump the flight ring BEFORE the fault takes effect — a kind
+        # like ``ps_crash`` SIGKILLs this very process, and the ring is
+        # the only record of what it was doing in its final seconds.
+        tracing.flight_dump(f"fault:{kind}")
         return arg if arg is not None else 0.0
 
     def pending(self, kind: str, at: int) -> Optional[float]:

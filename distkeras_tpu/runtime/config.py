@@ -92,6 +92,40 @@ ENV_REGISTRY: dict = _declare(
            "Master switch for the telemetry registry; `0` swaps every "
            "span/counter/gauge/histogram for a no-op singleton.",
            "observability"),
+    EnvVar("DKTPU_TRACE", "bool", False,
+           "Fleet-wide distributed tracing (`telemetry/tracing/`): commit "
+           "and serve requests carry a `(trace, parent)` context across "
+           "processes (capability-gated — peers without `CAPS['tracing']` "
+           "see zero new bytes) and every process records span/flight "
+           "evidence. Off by default: no trace ids, no extra wire fields, "
+           "no span records.",
+           "observability"),
+    EnvVar("DKTPU_TRACE_DIR", "str", "",
+           "Directory for per-process trace streams "
+           "(`trace-<role>-<pid>.jsonl`, appended per span so a SIGKILL "
+           "loses at most one torn line) and flight-recorder dumps "
+           "(`flight-<role>-<pid>.jsonl`). Empty = fall back to "
+           "`DKTPU_PS_STATE_DIR`; with neither set, spans still ride the "
+           "in-memory telemetry event stream and the flight ring.",
+           "observability"),
+    EnvVar("DKTPU_TRACE_RING", "int", 256,
+           "Flight-recorder capacity: recent telemetry events + trace "
+           "spans kept in a bounded in-memory ring per process, dumped on "
+           "fault injection, epoch fencing, SIGTERM, and unhandled crash.",
+           "observability"),
+    EnvVar("DKTPU_TRACE_ROLE", "str", "",
+           "Role label (`ps`, `standby`, `shard0`, `worker1`, `serve`, "
+           "...) stamped into every trace/flight/process-info record this "
+           "process writes; the netps CLI and the fleet `Job` launcher set "
+           "it automatically, so only hand-launched processes need it.",
+           "observability"),
+    EnvVar("DKTPU_TELEMETRY_ROTATE_MB", "float", 0.0,
+           "Size bound (MiB) for telemetry/trace JSONL files: a file at or "
+           "over the bound is rotated (atomic rename to `<path>.<n>`, "
+           "generations numbered from 1) before the next append; the "
+           "collector reads generations in order. 0 = no rotation "
+           "(unbounded growth under streaming workloads).",
+           "observability"),
     EnvVar("DKTPU_NAN_GUARD", "bool", True,
            "On-device NaN/Inf round skip in the engine round bodies; `0` "
            "disables (poisoned rounds then propagate into the center).",

@@ -28,6 +28,7 @@ answered).
 from __future__ import annotations
 
 import itertools
+import json
 import socket
 import threading
 import time
@@ -44,6 +45,7 @@ from distkeras_tpu.resilience.backoff import full_jitter
 from distkeras_tpu.runtime import config
 from distkeras_tpu.serving import errors as serrors
 from distkeras_tpu.serving.batcher import MicroBatcher
+from distkeras_tpu.telemetry import tracing
 
 _POLL_S = 0.2
 _FRAME_COMPLETE_S = 30.0
@@ -208,11 +210,19 @@ class ServingFrontend:
         req = header.get("req")
         if op == wire.OP_STATS:
             b, version = self.registry.current()
+            n = max(0, int(header.get("ring", 0) or 0))
+            # Ring records may carry non-JSON payloads (exception reprs);
+            # round-trip through default=str so one odd record cannot
+            # poison the stats reply frame.
+            ring = json.loads(json.dumps(tracing.ring_head(n),
+                                         default=str)) if n else []
             wire.send_frame(conn, wire.KIND_REPLY, {
                 "op": op, "req": req, "version": version,
                 "queue_rows": self.batcher.depth_rows(),
                 "served": self.served, "compiles": b.compiles(),
-                "caps": wire.CAPS}, [])
+                "caps": wire.CAPS, "role": tracing.role(),
+                "snapshot": telemetry.get().snapshot(),
+                "ring": ring}, [])
             return True
         if op != wire.OP_INFER:
             wire.send_frame(conn, wire.KIND_REPLY, {
@@ -232,8 +242,10 @@ class ServingFrontend:
         # Wire arrays view the per-frame buffer; copy before they outlive
         # this handler's frame (the dispatch thread concatenates later).
         inputs = tuple(np.array(a, copy=True) for a in arrays)
+        tctx = tracing.header_ctx(header)
         try:
             pending = self.batcher.submit(inputs, int(inputs[0].shape[0]))
+            pending.trace = tctx
         except serrors.ServingError as e:
             wire.send_frame(conn, wire.KIND_REPLY, {
                 "error": serrors.error_kind(e), "req": req,
@@ -267,6 +279,7 @@ class ServingFrontend:
                 continue
             bucketed, version = self.registry.current()
             rows = sum(p.rows for p in batch)
+            d_wall, d0 = time.time(), time.perf_counter()
             try:
                 with telemetry.span("serving.dispatch"):
                     joined = tuple(
@@ -278,6 +291,19 @@ class ServingFrontend:
                     p.answer(error=serrors.ServingError(
                         f"dispatch failed: {type(e).__name__}: {e}"))
                 continue
+            d_dur = time.perf_counter() - d0
+            for p in batch:
+                if p.trace is not None:
+                    # Two server-side segments per traced request: how
+                    # long it queued behind the coalescing wait, and the
+                    # shared forward pass it rode (same span per batch
+                    # member — the batch IS the shared resource).
+                    tracing.emit("serve.queue", p.trace, p.admitted_wall,
+                                 max(0.0, d_wall - p.admitted_wall),
+                                 rows=p.rows)
+                    tracing.emit("serve.batch", p.trace, d_wall, d_dur,
+                                 rows=rows, requests=len(batch),
+                                 version=version)
             telemetry.counter("serving.batches").add(1)
             telemetry.counter("serving.batched_rows").add(rows)
             from distkeras_tpu.serving.batcher import bucket_for
@@ -326,6 +352,9 @@ class ServeClient:
         self._sock: Optional[socket.socket] = None
         self._req = itertools.count()
         self._lock = threading.Lock()
+        #: capability map learned from the first traced request's ``stats``
+        #: exchange (serving has no ``join``); None = not yet asked.
+        self._peer_caps: Optional[dict] = None
 
     @property
     def endpoints(self) -> list:
@@ -412,15 +441,44 @@ class ServeClient:
 
     # -- ops ----------------------------------------------------------------
 
+    def _traced(self, header: dict) -> dict:
+        """Trace-context wire fields, gated on the replica set having
+        advertised ``CAPS["tracing"]`` — a peer that never did is sent
+        zero new bytes (absent JSON keys), same rule as PSClient."""
+        if tracing.enabled() and (self._peer_caps or {}).get("tracing"):
+            header.update(tracing.wire_fields())
+        return header
+
+    def _learn_caps(self) -> None:
+        """One-shot capability discovery: serving has no ``join``
+        handshake, so the first traced ``infer`` asks ``stats`` for the
+        peer's CAPS. A failed probe records ``{}`` (trace locally, send
+        nothing) — the data path must not inherit the probe's failure."""
+        if self._peer_caps is not None or not tracing.enabled():
+            return
+        try:
+            self._peer_caps = dict(self.stats().get("caps") or {})
+        except (serrors.ServingError, RPCTimeoutError, OSError):
+            self._peer_caps = {}
+
     def infer(self, *arrays) -> tuple[np.ndarray, int]:
         """One inference round-trip: ``(outputs, model_version)`` for the
         caller's rows (leading axis)."""
         arrays = tuple(np.ascontiguousarray(a) for a in arrays)
-        header, out = self._rpc({"op": wire.OP_INFER}, arrays)
+        rows = int(arrays[0].shape[0]) if arrays and arrays[0].ndim else 0
+        self._learn_caps()
+        with tracing.trace_scope("serve.request", rows=rows):
+            with tracing.child_scope("serve.wire"):
+                header, out = self._rpc(
+                    self._traced({"op": wire.OP_INFER}), arrays)
         return out[0], int(header.get("version", -1))
 
-    def stats(self) -> dict:
-        header, _ = self._rpc({"op": wire.OP_STATS}, [])
+    def stats(self, ring: int = 0) -> dict:
+        """The replica's live stats frame; ``ring`` > 0 also returns the
+        head of its flight-recorder ring (the scrape CLI's path)."""
+        header, _ = self._rpc(
+            {"op": wire.OP_STATS, **({"ring": int(ring)} if ring else {})},
+            [])
         return header
 
     def close(self) -> None:

@@ -313,6 +313,18 @@ class _NoopMetric:
 _NOOP_METRIC = _NoopMetric()
 
 
+#: optional observer of every recorded event (the tracing flight ring
+#: registers here at import) — a plain callable taking the event dict.
+#: Core stays import-clean: it never imports tracing; tracing plugs in.
+_EVENT_TAP = None
+
+
+def set_event_tap(tap) -> None:
+    """Install (or clear, with None) the process-wide event observer."""
+    global _EVENT_TAP
+    _EVENT_TAP = tap
+
+
 class Telemetry:
     """Per-process metric registry: named spans, counters, gauges, histograms.
 
@@ -385,6 +397,9 @@ class Telemetry:
             rec.update(fields)
         with self._lock:
             self._events.append(rec)
+        tap = _EVENT_TAP
+        if tap is not None:
+            tap(rec)
 
     # -- export ------------------------------------------------------------
     def snapshot(self) -> dict:

@@ -14,13 +14,37 @@ is the matching reader used by the round-trip tests.
 from __future__ import annotations
 
 import json
+import os
 import re
 import time
 from typing import Optional, TextIO, Union
 
+from distkeras_tpu.runtime import config
 from distkeras_tpu.telemetry.core import BUCKET_BOUNDS, Telemetry
 
 SUMMARY_KIND = "telemetry_summary"
+
+
+def rotate_jsonl(path: str) -> Optional[str]:
+    """Size-bounded JSONL rotation (``DKTPU_TELEMETRY_ROTATE_MB``): a file
+    at/over the bound is atomically renamed to the next ``<path>.<n>``
+    generation (numbered from 1, oldest first) so the next append starts a
+    fresh live file; the collector reads generations in order. Returns the
+    generation path, or None when no rotation was due (0 = disabled)."""
+    mb = config.env_float("DKTPU_TELEMETRY_ROTATE_MB") or 0.0
+    limit = int(mb * (1 << 20))
+    if not limit:
+        return None
+    try:
+        if not os.path.exists(path) or os.path.getsize(path) < limit:
+            return None
+        n = 1
+        while os.path.exists(f"{path}.{n}"):
+            n += 1
+        os.replace(path, f"{path}.{n}")
+        return f"{path}.{n}"
+    except OSError:
+        return None
 
 
 def write_jsonl(tele: Telemetry, path_or_file: Union[str, TextIO],
@@ -30,13 +54,20 @@ def write_jsonl(tele: Telemetry, path_or_file: Union[str, TextIO],
 
     ``since`` (a :meth:`Telemetry.mark`) windows the dump to activity after
     the mark — how per-run clients (MetricsLogger) share the process-global
-    registry without re-attributing a previous run's work."""
+    registry without re-attributing a previous run's work. Each dump leads
+    with one ``process_info`` identity record (host/pid/role/boot_id +
+    clock-offset estimate) so the cross-process collector can attribute
+    and align the stream; path dumps rotate first when
+    ``DKTPU_TELEMETRY_ROTATE_MB`` says the file is due."""
     if since is not None:
         summary, events = tele.delta(since)
     else:
         summary, events = tele.snapshot(), tele.events()
 
     def _write(f: TextIO) -> None:
+        from distkeras_tpu.telemetry.tracing import process_info_record
+
+        f.write(json.dumps(process_info_record()) + "\n")
         for ev in events:
             f.write(json.dumps(ev) + "\n")
         rec = {"kind": SUMMARY_KIND, "ts": time.time(), **summary}
@@ -45,6 +76,7 @@ def write_jsonl(tele: Telemetry, path_or_file: Union[str, TextIO],
         f.write(json.dumps(rec) + "\n")
 
     if isinstance(path_or_file, str):
+        rotate_jsonl(path_or_file)
         with open(path_or_file, "a") as f:
             _write(f)
     else:

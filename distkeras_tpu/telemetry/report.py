@@ -527,25 +527,89 @@ def render_report(report: dict) -> str:
     return out.getvalue()
 
 
+def merged_records(path: str) -> list[dict]:
+    """The clock-aligned, deduped record list for ``path``: a directory
+    is collector-merged across every per-process stream (+ rotated
+    generations) it holds; a single file goes through the same collector
+    so one-stream and N-stream paths render identically."""
+    import os
+
+    from distkeras_tpu.telemetry.tracing import TelemetryCollector
+
+    if os.path.isdir(path):
+        return TelemetryCollector.from_dir(path).records()
+    return TelemetryCollector([path]).records()
+
+
+def scrape_stats(endpoint: str, ring: int = 64,
+                 timeout: float = 5.0) -> dict:
+    """One live ``stats`` frame from a PS/serving process: counters,
+    gauges, and the head of its flight-recorder ring — no join, no
+    membership, works against a standby or a fenced ex-primary (the
+    processes a postmortem most wants to ask)."""
+    import socket
+
+    from distkeras_tpu.netps import wire
+
+    host, port = wire.split_endpoint(endpoint)
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(timeout)
+        wire.send_frame(sock, wire.KIND_REQUEST,
+                        {"op": wire.OP_STATS, "req": 0,
+                         "ring": int(ring)}, [])
+        while True:
+            kind, rhdr, _arrays = wire.read_frame(sock)
+            if kind == wire.KIND_REPLY and rhdr.get("req") == 0:
+                return rhdr
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     import argparse
+    import json
 
     parser = argparse.ArgumentParser(
         prog="python -m distkeras_tpu.telemetry",
         description="Render a run report from a metrics/telemetry JSONL.")
     sub = parser.add_subparsers(dest="command", required=True)
     rep = sub.add_parser("report", help="render a per-run report")
-    rep.add_argument("path", help="metrics/telemetry JSONL file")
+    rep.add_argument("path", help="metrics/telemetry JSONL file (or, with "
+                                  "--trace, a directory of per-process "
+                                  "streams to collector-merge)")
     rep.add_argument("--straggler-k", type=float, default=STRAGGLER_K,
                      help="flag rounds slower than k x median "
                           f"(default {STRAGGLER_K})")
+    rep.add_argument("--trace", action="store_true",
+                     help="render the distributed-trace report (critical-"
+                          "path breakdown, completeness, chaos "
+                          "correlation) instead of the run report")
     rep.add_argument("--json", action="store_true",
                      help="emit the structured report as JSON instead of text")
+    scr = sub.add_parser(
+        "scrape", help="fetch a live telemetry snapshot from a running "
+                       "PS/serving process over the wire")
+    scr.add_argument("endpoint", help="host:port of the process to scrape")
+    scr.add_argument("--ring", type=int, default=64,
+                     help="flight-ring records to include (default 64)")
+    scr.add_argument("--timeout", type=float, default=5.0)
     args = parser.parse_args(argv)
+    if args.command == "scrape":
+        print(json.dumps(scrape_stats(args.endpoint, ring=args.ring,
+                                      timeout=args.timeout),
+                         default=str, indent=2))
+        return 0
+    if args.trace:
+        from distkeras_tpu.telemetry.tracing import (render_trace_report,
+                                                     trace_report)
+
+        report = trace_report(merged_records(args.path))
+        if args.json:
+            print(json.dumps(report, default=float))
+        else:
+            print(render_trace_report(report), end="")
+        return 0
     report = build_report(args.path, k=args.straggler_k)
     if args.json:
-        import json
-
         print(json.dumps(report, default=float))
     else:
         print(render_report(report), end="")

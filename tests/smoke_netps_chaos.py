@@ -137,6 +137,96 @@ def _assert_journal_invariants(state_dir, label):
     return records, last_epoch
 
 
+def _assert_trace_evidence(state_dir, standby_mode) -> None:
+    """Trace-mode evidence (``DKTPU_TRACE=1`` on the failover drill): the
+    collector-merged streams must show every accepted commit as one
+    complete cross-process trace with no orphaned server-side spans, and
+    the SIGKILLed primary's flight-recorder dump must agree with the
+    on-disk journal it left behind. See docs/OBSERVABILITY.md
+    ("Distributed tracing")."""
+    import glob
+    import json
+
+    from distkeras_tpu.telemetry import tracing
+    from distkeras_tpu.telemetry.tracing import analysis as trace_analysis
+
+    trace_dir = tracing.trace_dir()
+    assert trace_dir, "DKTPU_TRACE=1 but no DKTPU_TRACE_DIR to collect from"
+    # The smoke process's own registry (chaos-proxy events + anything the
+    # tap saw) joins the subprocess streams on disk before the merge.
+    telemetry.write_jsonl(
+        telemetry.get(),
+        os.path.join(trace_dir, f"telemetry-trainer-{os.getpid()}.jsonl"))
+    records = tracing.TelemetryCollector.from_dir(trace_dir).records()
+    rep = tracing.trace_report(records)
+    assert not rep["orphans"], (
+        f"{len(rep['orphans'])} server-side trace(s) never joined a client "
+        f"root: {rep['orphans'][:5]}")
+
+    # Every journaled (= accepted) commit must map to a traced commit
+    # carrying every always-on critical-path segment plus fsync (a state
+    # dir is configured). ``replicate`` is deliberately NOT demanded:
+    # commits folded by the promoted standby after the crash have nobody
+    # left to replicate to, so a promotion legitimately ends that segment.
+    base = set(trace_analysis.BASE_REQUIRED) | {"fsync"}
+    traced = {}
+    for _tid, t in trace_analysis.assemble_traces(records).items():
+        root = t["root"]
+        if root is not None and root.get("name") == "commit":
+            traced[(int(root["wid"]), int(root["seq"]))] = (
+                trace_analysis._segment_durs(t["spans"]))
+    accepted = set()
+    for d in [state_dir] + ([state_dir + ".standby"] if standby_mode else []):
+        for r in netps_state.read_journal(d):
+            accepted.add((int(r["wid"]), int(r["seq"])))
+    untraced = sorted(k for k in accepted if k not in traced)
+    assert not untraced, f"accepted commits left no trace: {untraced[:5]}"
+    incomplete = sorted(k for k in accepted if not base <= set(traced[k]))
+    assert not incomplete, (
+        "accepted commits with gaps in the critical path: "
+        f"{[(k, sorted(traced[k])) for k in incomplete[:5]]}")
+
+    # The ps_crash dump: FaultPlan._fire wrote the flight ring BEFORE the
+    # SIGKILL, so the primary's final seconds are on disk. Its fold tail
+    # must agree with the journal the dead process left behind.
+    dumps = sorted(glob.glob(os.path.join(trace_dir, "flight-ps-*.jsonl")))
+    assert dumps, "the SIGKILLed primary left no flight-recorder dump"
+    folds = []
+    with open(dumps[-1], encoding="utf-8") as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # a crash-truncated tail line is legal
+            if (rec.get("kind") == tracing.SPAN_KIND
+                    and rec.get("name") == "commit.fold"):
+                folds.append((int(rec["wid"]), int(rec["seq"])))
+    assert folds, "the flight dump recorded no folds before the crash"
+    # The journal rotates at every snapshot and prunes old generations,
+    # so the on-disk journal is the TAIL of fold history — and the ring
+    # saw more history than survived on disk. The journal writer is also
+    # an ordered background thread with a bounded queue, so at the
+    # SIGKILL the ring may lead the journal by up to that many folded-
+    # but-unwritten commits (plus the fold in flight) — but it must
+    # never DISAGREE: the journal must be a suffix of the ring's fold
+    # sequence once that bounded lead is stripped.
+    jkeys = [(int(r["wid"]), int(r["seq"]))
+             for r in netps_state.read_journal(state_dir)]
+    assert jkeys, "the crashed primary left no journal to corroborate"
+    jset, lead = set(jkeys), 0
+    while (folds and folds[-1] not in jset
+           and lead <= netps_state._WRITE_QUEUE):
+        folds.pop()
+        lead += 1
+    k = min(len(jkeys), len(folds))
+    assert k >= 1 and folds[-k:] == jkeys[-k:], (
+        f"flight-dump fold tail {folds[-k:]} disagrees with the on-disk "
+        f"journal tail {jkeys[-k:]} (crash-lead stripped: {lead})")
+    print(f"netps trace evidence: traces={rep['traces']} "
+          f"commits={rep['commits']} accepted={len(accepted)} orphans=0 "
+          f"flight_folds={len(folds)} processes={len(rep['processes'])}")
+
+
 def _run_failover(df, model) -> int:
     """Kill-the-primary mode: PS subprocess(es) + ps_crash, with either a
     babysitter cold restart or a warm-standby promotion riding it out."""
@@ -185,6 +275,12 @@ def _run_failover(df, model) -> int:
     endpoint = proxy.endpoint
     if standby_mode:
         endpoint = f"{endpoint},127.0.0.1:{sb_port}"
+    if os.environ.get("DKTPU_TRACE"):
+        # Label this process's spans in the merged timeline (the in-process
+        # API, not DKTPU_TRACE_ROLE: the env var would leak into the PS
+        # subprocesses and overwrite their own role stamps).
+        from distkeras_tpu.telemetry import tracing
+        tracing.set_role("trainer")
     try:
         trainer = ADAG(model, loss="sparse_categorical_crossentropy",
                        num_workers=4, batch_size=16, num_epoch=3,
@@ -230,6 +326,8 @@ def _run_failover(df, model) -> int:
     assert acc > 0.85, f"accuracy collapsed across the PS crash: {acc}"
     assert retries >= 1, "no RPC ever retried — chaos did not bite"
     assert len(records) >= 10, "journal is implausibly short"
+    if os.environ.get("DKTPU_TRACE"):
+        _assert_trace_evidence(state_dir, standby_mode)
     return 0
 
 
