@@ -120,6 +120,35 @@ def _prior_values() -> dict[str, float]:
     return {}
 
 
+def _health_summary(tele, results: list) -> dict:
+    """The BENCH_SUMMARY ``health_summary`` block: typed health-plane
+    alert traffic observed during the run plus any config that left its
+    pinned band, so the regression sentinels (and a human reading the
+    perf trajectory) see drift without re-deriving it."""
+    alerts = []
+    raised = cleared = 0
+    try:
+        for e in tele.events():
+            if e.get("kind") == "health_alert":
+                raised += 1
+                alerts.append({k: e.get(k) for k in
+                               ("alert", "severity", "message", "value",
+                                "tenant", "job") if e.get(k) is not None})
+            elif e.get("kind") == "health_clear":
+                cleared += 1
+    except Exception:  # diagnostics never fail the bench
+        pass
+    return {
+        "alerts_raised": raised,
+        "alerts_cleared": cleared,
+        "alerts": alerts,
+        "bench_regressions": [
+            {"metric": r.get("metric"), "value": r.get("value"),
+             "vs_baseline": r.get("vs_baseline")}
+            for r in results if r.get("within_band") is False],
+    }
+
+
 def _emit_summary(out: dict) -> None:
     """Emit the bench summary both ways the driver can consume it: as the
     process's FINAL stdout line (flushed, nothing printed after it — the
@@ -1331,6 +1360,11 @@ def main():
         # a compute one.
         "input_stall_fraction": headline.get("input_stall_fraction"),
         "configs": results,
+        # Health-plane rollup: alerts the run raised/cleared (counters +
+        # typed events from the telemetry registry) and configs that left
+        # their pinned band — the regression sentinel reads this block,
+        # so perf drift is visible in the same trajectory as perf itself.
+        "health_summary": _health_summary(tele, results),
     }
     # Telemetry JSONL beside the bench record (driver captures stdout into
     # BENCH_r*.json; the spans/counters/per-config events land here).

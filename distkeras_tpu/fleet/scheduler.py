@@ -108,7 +108,8 @@ class FleetScheduler:
                  preempt_grace: Optional[float] = None,
                  max_restarts: Optional[int] = None,
                  preemption: bool = True,
-                 expansion_policy=None):
+                 expansion_policy=None,
+                 health_hook=None):
         if capacity is None:
             capacity = config.env_int("DKTPU_FLEET_CAPACITY")
         if capacity < 1:
@@ -141,6 +142,18 @@ class FleetScheduler:
                 MarginalThroughputPolicy)
             expansion_policy = MarginalThroughputPolicy()
         self.expansion_policy = expansion_policy
+        #: optional health-plane hook (duck-typed: ``is_down(endpoint)``,
+        #: a ``MetricsHub`` fits) — consulted each tick for RUNNING jobs.
+        #: A job whose PS endpoint fails liveness is drained-to-requeue
+        #: immediately (progress lives on the PS, so the re-placed gang
+        #: resumes) instead of its workers burning the restart budget one
+        #: lease lapse at a time. The scheduler also registers each
+        #: RUNNING job's endpoint with the health target registry, so a
+        #: hub on this driver discovers the fleet without configuration.
+        self.health_hook = health_hook
+        #: endpoints already acted on while down — one requeue per
+        #: outage, not one per tick (cleared when the target recovers).
+        self._health_acted: set = set()
         self._jobs: list = []
         #: job -> {wid: _Worker} for every slot currently occupied (a
         #: released worker occupies its slot until its thread is reaped).
@@ -260,6 +273,7 @@ class FleetScheduler:
         jobs (runtime close + terminal event) OUTSIDE the lock."""
         with self._lock:
             self._reap()
+            self._consult_health()
             self._consult_chaos()
             if self._forced:
                 # A full drain can take more than asked; never owe negative.
@@ -478,6 +492,36 @@ class FleetScheduler:
             return
         job.state = to_state
         self._pending_close.append(job)
+
+    def _consult_health(self) -> None:
+        """Health-plane pass (lock held): keep RUNNING jobs' endpoints
+        registered for scraping and, when the hook reports one down,
+        requeue that job once per outage (see ``health_hook``)."""
+        if self.health_hook is None:
+            return
+        from distkeras_tpu import telemetry
+        from distkeras_tpu.telemetry.health import register_target
+
+        for job in self._jobs:
+            if job.state != RUNNING:
+                continue
+            ep = getattr(job.runtime, "endpoint", None)
+            if not ep:
+                continue
+            register_target(ep, f"fleet.{self._label(job)}")
+            if not self.health_hook.is_down(ep):
+                continue
+            if ep in self._health_acted:
+                continue  # already requeued for this outage
+            self._health_acted.add(ep)
+            telemetry.counter("fleet.liveness_requeues").add(1)
+            telemetry.event("fleet_liveness_requeue", {
+                "tenant": job.tenant, "job": job.name, "endpoint": ep})
+            self._drain(job, to_state=QUEUED)
+        # Forget an outage once the target answers again, so the NEXT
+        # outage of the same endpoint gets its own requeue.
+        self._health_acted = {ep for ep in self._health_acted
+                              if self.health_hook.is_down(ep)}
 
     def _consult_chaos(self) -> None:
         """Scan the ``preempt@R`` schedule over every cumulative-commit

@@ -471,7 +471,8 @@ class Job:
 
     def supervise(self, timeout: float, grace: float = 5.0,
                   max_restarts: int = 0, restart_backoff: float = 1.0,
-                  straggler_timeout: Optional[float] = None) -> list[int]:
+                  straggler_timeout: Optional[float] = None,
+                  health=None) -> list[int]:
         """Babysit the job like a cluster manager. Polls until every process
         exits. A host that exits nonzero is **restarted** (same command, up
         to ``max_restarts`` times per host, after a full-jitter delay drawn
@@ -483,12 +484,24 @@ class Job:
         first host finished cleanly are declared stragglers and killed.
         Returns final exit codes (negative signal numbers for processes the
         teardown killed). This is the host-failure detection AND recovery
-        the reference delegated to Spark's task retry."""
+        the reference delegated to Spark's task retry.
+
+        ``health`` is the optional health-plane hook — anything with a
+        ``MetricsHub``-shaped ``is_down(endpoint)``. A PS-plane process
+        whose endpoint has failed liveness (stopped answering scrapes
+        while the OS process is still alive — wedged, not dead) is killed
+        here so :meth:`_revive_ps` restarts it within the ordinary budget
+        on the next sweep, instead of every client waiting for the lease
+        to lapse and the standby to promote."""
         from distkeras_tpu import telemetry
 
+        if health is not None:
+            self.register_health_targets()
         deadline = time.monotonic() + timeout
         first_done_ok: Optional[float] = None
         while time.monotonic() < deadline:
+            if health is not None:
+                self._liveness_kill(health)
             self._revive_ps(max_restarts, restart_backoff)
             rcs = self.poll()
             failed = [i for i, rc in enumerate(rcs) if rc not in (None, 0)]
@@ -570,6 +583,72 @@ class Job:
                 "role": role, "exit_code": p.returncode,
                 "restart": self.ps_restarts})
             put(self._spawn_cmd(host, cmd_fn()))
+
+    def _ps_endpoint_for_role(self, role: str) -> Optional[str]:
+        """The scrape endpoint behind a :meth:`_ps_plane` role name, None
+        when the card doesn't configure one (e.g. ``standby`` with no
+        ``standby_host``)."""
+        pc = self.punchcard
+        if pc.ps is None:
+            return None
+        matrix = pc.ps_endpoint() or ""
+        if pc.ps_shard_count() > 1:
+            groups = [g.split(",") for g in matrix.split(";")]
+            if not role.startswith("shard-"):
+                return None
+            k = int(role.split("-")[1])
+            if k >= len(groups):
+                return None
+            if role.endswith("-standby"):
+                return groups[k][1] if len(groups[k]) > 1 else None
+            return groups[k][0]
+        if role == "primary":
+            return matrix.split(",")[0]
+        if role == "standby":
+            return pc.ps_standby_endpoint()
+        return None
+
+    def register_health_targets(self) -> dict:
+        """File every live PS-plane endpoint with the health plane's
+        in-process target registry (``<job>.<role>``, tenant-prefixed
+        when the card bills one) so a ``MetricsHub`` on this driver
+        scrapes them without configuration. Returns ``{name: endpoint}``
+        for what was registered."""
+        from distkeras_tpu.telemetry.health import register_target
+
+        labels = self._labels()
+        prefix = (f"{labels['tenant']}." if "tenant" in labels else "")
+        out = {}
+        for role, get, _put, _cmd_fn, _host in self._ps_plane():
+            if get() is None:
+                continue
+            ep = self._ps_endpoint_for_role(role)
+            if ep:
+                name = f"{prefix}{labels['job']}.{role}"
+                register_target(ep, name)
+                out[name] = ep
+        return out
+
+    def _liveness_kill(self, health) -> None:
+        """Kill (SIGKILL — it is wedged, SIGTERM assumes cooperation) any
+        live PS process whose endpoint the health hook reports down; the
+        next :meth:`_revive_ps` sweep restarts it under its role budget."""
+        from distkeras_tpu import telemetry
+
+        for role, get, _put, _cmd_fn, _host in self._ps_plane():
+            p = get()
+            if p is None or p.poll() is not None:
+                continue
+            ep = self._ps_endpoint_for_role(role)
+            if not ep or not health.is_down(ep):
+                continue
+            telemetry.counter("resilience.liveness_kills").add(1)
+            telemetry.event("liveness_kill", {
+                **self._labels(), "role": role, "endpoint": ep})
+            try:
+                p.kill()
+            except OSError:
+                pass
 
     def _ps_plane(self) -> list:
         """The PS-plane roster ``(role, get, put, cmd_fn, host)`` that

@@ -99,6 +99,9 @@ def main(argv=None) -> int:
     else:
         tracing.set_role("ps")
     tracing.install_crash_hooks()
+    from distkeras_tpu.telemetry.vitals import start_vitals
+
+    start_vitals()  # no-op unless DKTPU_VITALS_S is set
     if standby_of:
         from distkeras_tpu.netps.standby import StandbyServer
 

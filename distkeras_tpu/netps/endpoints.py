@@ -76,6 +76,21 @@ class EndpointWalker:
                     on_walk()
         return walked
 
+    def reorder(self, order: list,
+                on_walk: Optional[Callable[[], None]] = None) -> None:
+        """Adopt a new traversal order (health-aware clients float ready
+        replicas to the front) and restart from its head. Must be a
+        permutation — reordering may deprioritize an endpoint, never
+        forget one. Teardown under the lock, same as :meth:`walk`."""
+        if sorted(order) != sorted(self.endpoints):
+            raise ValueError("reorder() needs a permutation of the "
+                             "walker's endpoints")
+        with self._lock:
+            self.endpoints = list(order)
+            self._idx = 0
+            if on_walk is not None:
+                on_walk()
+
     def advance(self, on_walk: Optional[Callable[[], None]] = None) -> None:
         """Unconditional advance — the single-threaded client form (one
         request in flight, every failure is ours). Teardown under the lock,

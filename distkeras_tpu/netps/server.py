@@ -1098,7 +1098,13 @@ class PSServer:
             extra = {"updates": self._updates, "epoch": self.epoch,
                      "members": len(self._members),
                      "commits_total": self.commits_total,
-                     "draining": self._draining}
+                     "draining": self._draining,
+                     # Readiness contract for the health plane: a primary
+                     # that can take commits. Standbys answer stats (the
+                     # whole point of the membership-free op) but report
+                     # not-ready until promoted; fenced/draining likewise.
+                     "ready": (not self._draining and not self._fenced
+                               and not self._not_primary)}
         # The ring rides the JSON header: round-trip through json with a
         # str fallback first — event fields may carry non-JSON scalars,
         # and a scrape must never poison the reply frame.

@@ -126,6 +126,44 @@ ENV_REGISTRY: dict = _declare(
            "collector reads generations in order. 0 = no rotation "
            "(unbounded growth under streaming workloads).",
            "observability"),
+    EnvVar("DKTPU_HEALTH_TARGETS", "str", "",
+           "Ad-hoc scrape targets for the health plane's `MetricsHub`: "
+           "`[name=]host:port` entries separated by `;` (or `,`), merged "
+           "with the in-process registry fleet components populate "
+           "automatically. Re-read every sweep, so targets can be added "
+           "while the hub runs.",
+           "observability"),
+    EnvVar("DKTPU_HEALTH_INTERVAL", "float", 2.0,
+           "Seconds between `MetricsHub` scrape sweeps over the registered "
+           "targets (each sweep is one `stats` frame per target — no "
+           "membership, no lease traffic).",
+           "observability"),
+    EnvVar("DKTPU_HEALTH_RING", "int", 240,
+           "Points kept per metric time-series ring in the hub (per "
+           "target, per metric). At the default 2 s interval, 240 points "
+           "is an 8-minute window — enough to cover the default slow "
+           "burn-rate window with slack.",
+           "observability"),
+    EnvVar("DKTPU_HEALTH_DOWN_AFTER", "int", 3,
+           "Consecutive missed scrapes after which a previously-reachable "
+           "target is declared down (the `target_down` sentinel fires a "
+           "page alert; supervisors consulting `MetricsHub.is_down` may "
+           "restart it).",
+           "observability"),
+    EnvVar("DKTPU_HEALTH_SLO", "str", "",
+           "SLO specs for the health plane: inline JSON (starts with `[` "
+           "or `{`) or a path to a JSON file. Each spec names a hub "
+           "metric, a stat (`value`/`mean`/`rate`/`p99`/...), one bound "
+           "(`max` or `min`), burn-rate windows (`fast_s`/`slow_s`), and "
+           "a severity (`page` dumps the flight recorder on fire).",
+           "observability"),
+    EnvVar("DKTPU_VITALS_S", "float", 0.0,
+           "Process-vitals sample interval (seconds): periodic "
+           "`runtime.rss_mb`, `runtime.open_fds`, and (when jax sees a "
+           "device) `device.bytes_in_use` gauges feeding the hub via the "
+           "stats op. 0 = off; the netps CLI and the serving frontend "
+           "start the sampler when set.",
+           "observability"),
     EnvVar("DKTPU_NAN_GUARD", "bool", True,
            "On-device NaN/Inf round skip in the engine round bodies; `0` "
            "disables (poisoned rounds then propagate into the center).",

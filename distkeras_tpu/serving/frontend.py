@@ -95,12 +95,24 @@ class ServingFrontend:
     def endpoint(self) -> str:
         return f"{self.host}:{self.port}"
 
+    @property
+    def ready(self) -> bool:
+        """Liveness vs readiness: a started replica answers stats (live)
+        but is only *ready* once the registry holds a warmed model and no
+        swap probe is in flight — the window where an infer would block
+        on warmup compile is exactly what health-aware clients skip."""
+        return (self._started and not self._stop.is_set()
+                and not getattr(self.registry, "warming", False))
+
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> "ServingFrontend":
         if self._started:
             return self
         self._started = True
+        from distkeras_tpu.telemetry.vitals import start_vitals
+
+        start_vitals()  # no-op unless DKTPU_VITALS_S is set
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((self.host, self.port))
@@ -209,6 +221,7 @@ class ServingFrontend:
         op = header.get("op")
         req = header.get("req")
         if op == wire.OP_STATS:
+            st1 = time.time() if "ct0" in header else None
             b, version = self.registry.current()
             n = max(0, int(header.get("ring", 0) or 0))
             # Ring records may carry non-JSON payloads (exception reprs);
@@ -216,13 +229,24 @@ class ServingFrontend:
             # poison the stats reply frame.
             ring = json.loads(json.dumps(tracing.ring_head(n),
                                          default=str)) if n else []
-            wire.send_frame(conn, wire.KIND_REPLY, {
+            reply = {
                 "op": op, "req": req, "version": version,
                 "queue_rows": self.batcher.depth_rows(),
                 "served": self.served, "compiles": b.compiles(),
                 "caps": wire.CAPS, "role": tracing.role(),
+                # Readiness contract: a replica mid-warmup/mid-swap (the
+                # registry holds no probed model yet) answers stats but
+                # reports not-ready so health-aware clients walk past it.
+                "ready": self.ready,
                 "snapshot": telemetry.get().snapshot(),
-                "ring": ring}, [])
+                "ring": ring}
+            if st1 is not None:
+                # Same NTP-style exchange the PS `_serve_frame` does: echo
+                # receive/send stamps so the health hub (and the tracing
+                # collector) can estimate this replica's clock offset.
+                reply["st1"] = st1
+                reply["st2"] = time.time()
+            wire.send_frame(conn, wire.KIND_REPLY, reply, [])
             return True
         if op != wire.OP_INFER:
             wire.send_frame(conn, wire.KIND_REPLY, {
@@ -480,6 +504,51 @@ class ServeClient:
             {"op": wire.OP_STATS, **({"ring": int(ring)} if ring else {})},
             [])
         return header
+
+    def prefer_ready(self, probe_timeout: float = 0.5) -> list:
+        """Health-aware walk ordering: one short stats probe per replica,
+        then park the walker on the first *ready* one — warming/swapping
+        replicas (``ready: false``) and unreachable ones sink to the back
+        of the failover order instead of eating the first attempts.
+
+        Best-effort by design: probes that fail prove nothing (the
+        replica may be one accept-loop tick away), so the relative order
+        within each class is preserved and nothing is removed — failover
+        can still reach a not-ready replica if every ready one dies.
+        Returns the new (host, port) order."""
+        ready, warming, dark = [], [], []
+        for host, port in self._walker.endpoints:
+            try:
+                with socket.create_connection(
+                        (host, port), timeout=probe_timeout) as sock:
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                    sock.settimeout(probe_timeout)
+                    wire.send_frame(sock, wire.KIND_REQUEST,
+                                    {"op": wire.OP_STATS, "req": 0,
+                                     "ring": 0}, [])
+                    while True:
+                        kind, rhdr, _ = wire.read_frame(sock)
+                        if kind == wire.KIND_REPLY and rhdr.get("req") == 0:
+                            break
+                (ready if rhdr.get("ready", True) else warming).append(
+                    (host, port))
+            except (ConnectionError, ProtocolError, socket.timeout,
+                    OSError):
+                dark.append((host, port))
+        order = ready + warming + dark
+
+        def teardown():
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+        with self._lock:
+            self._walker.reorder(order, on_walk=teardown)
+        return list(order)
 
     def close(self) -> None:
         if self._sock is not None:

@@ -43,9 +43,15 @@ class ModelRegistry:
         self._model = model
         self.buckets = tuple(buckets)
         self._lock = threading.Lock()
+        #: True while a warmup/swap probe is compiling — the not-ready
+        #: window the frontend's stats op reports to the health plane.
+        self.warming = True
         self._bucketed = BucketedModel(model, self.buckets)
-        if warmup:
-            self._bucketed.warmup()
+        try:
+            if warmup:
+                self._bucketed.warmup()
+        finally:
+            self.warming = False
         self._version = -1
         self._failed: set[int] = set()
         self._ckpt = None
@@ -88,7 +94,11 @@ class ModelRegistry:
             if step <= self._version or step in self._failed:
                 continue
             try:
-                candidate = self._load_and_probe(step)
+                self.warming = True
+                try:
+                    candidate = self._load_and_probe(step)
+                finally:
+                    self.warming = False
             except Exception as e:  # noqa: BLE001 - fall back to next step
                 self._failed.add(step)
                 telemetry.counter("serving.swap_failures").add(1)

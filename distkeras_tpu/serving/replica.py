@@ -57,7 +57,11 @@ class ServingReplicaSet:
 
     def start_replica(self, i: int) -> ServingFrontend:
         """(Re)start replica ``i``: fresh registry, fresh pool port —
-        exactly what a crashed replica's supervisor would do."""
+        exactly what a crashed replica's supervisor would do. The new
+        endpoint is filed with the health target registry (``serve<i>``)
+        so a MetricsHub on this process scrapes the set automatically."""
+        from distkeras_tpu.telemetry.health import register_target
+
         with self._lock:
             if self.replicas[i] is not None:
                 return self.replicas[i]
@@ -69,10 +73,14 @@ class ServingReplicaSet:
             front = ServingFrontend(registry, host=self.host,
                                     **self._kw).start()
             self.replicas[i] = front
-            return front
+        register_target(front.endpoint, f"serve{i}")
+        return front
 
     def kill(self, i: int) -> None:
-        """Chaos: crash replica ``i`` (no drain, no typed replies)."""
+        """Chaos: crash replica ``i`` (no drain, no typed replies). The
+        health registration is left in place on purpose: a crash is
+        exactly what the ``target_down`` sentinel exists to catch, and
+        ``start_replica(i)`` re-files the name with the new endpoint."""
         with self._lock:
             front, self.replicas[i] = self.replicas[i], None
         if front is not None:
@@ -80,10 +88,15 @@ class ServingReplicaSet:
             front.registry.close()
 
     def stop_replica(self, i: int) -> None:
-        """Graceful: drain replica ``i``'s queue with typed replies."""
+        """Graceful: drain replica ``i``'s queue with typed replies (and
+        un-file it from the health registry — a deliberate stop must not
+        page as an outage)."""
+        from distkeras_tpu.telemetry.health import unregister_target
+
         with self._lock:
             front, self.replicas[i] = self.replicas[i], None
         if front is not None:
+            unregister_target(f"serve{i}")
             front.close()
             front.registry.close()
 

@@ -592,16 +592,54 @@ def main(argv: Optional[list[str]] = None) -> int:
     scr.add_argument("--ring", type=int, default=64,
                      help="flight-ring records to include (default 64)")
     scr.add_argument("--timeout", type=float, default=5.0)
+    scr.add_argument("--json", action="store_true",
+                     help="one compact JSON line (scripts/pipelines) "
+                          "instead of the indented dump")
+    from distkeras_tpu.telemetry.health import cli as health_cli
+
+    health_cli.add_subcommands(sub)
     args = parser.parse_args(argv)
+    if args.command == "health":
+        return health_cli.cmd_health(args)
+    if args.command == "top":
+        return health_cli.cmd_top(args)
     if args.command == "scrape":
-        print(json.dumps(scrape_stats(args.endpoint, ring=args.ring,
-                                      timeout=args.timeout),
-                         default=str, indent=2))
+        import socket
+        import sys
+
+        try:
+            stats = scrape_stats(args.endpoint, ring=args.ring,
+                                 timeout=args.timeout)
+        except (ConnectionError, socket.timeout, OSError) as e:
+            # Typed single-line error, not a traceback: an unreachable
+            # process is a *finding* for an operator, not a crash.
+            kind = ("timeout" if isinstance(e, socket.timeout)
+                    else "connection_refused"
+                    if isinstance(e, ConnectionRefusedError)
+                    else "unreachable")
+            print(f"scrape error: {kind}: {args.endpoint} "
+                  f"({type(e).__name__}: {e})", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(stats, default=str))
+        else:
+            print(json.dumps(stats, default=str, indent=2))
         return 0
     if args.trace:
+        import os
+        import sys
+
         from distkeras_tpu.telemetry.tracing import (render_trace_report,
                                                      trace_report)
 
+        if not os.path.exists(args.path):
+            # Contract (pinned by tests): a path that does not exist is an
+            # operator error -> one line on stderr, exit 2. An EXISTING
+            # dir with no records renders the empty report, exit 0 (a
+            # fleet that traced nothing is a valid, boring answer).
+            print(f"trace report: no such file or directory: {args.path}",
+                  file=sys.stderr)
+            return 2
         report = trace_report(merged_records(args.path))
         if args.json:
             print(json.dumps(report, default=float))

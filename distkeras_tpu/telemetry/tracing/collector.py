@@ -77,7 +77,16 @@ class TelemetryCollector:
     @classmethod
     def from_dir(cls, directory: str) -> "TelemetryCollector":
         coll = cls()
-        for p in sorted(_glob.glob(os.path.join(directory, "*.jsonl"))):
+        base_paths = set(_glob.glob(os.path.join(directory, "*.jsonl")))
+        # A stream whose live file was rotated away (or never re-created
+        # before the process died) exists only as `<base>.jsonl.N`; the
+        # collector is keyed by base path — `generations()` finds the
+        # .N files — so discover bases from rotated names too.
+        for p in _glob.glob(os.path.join(directory, "*.jsonl.*")):
+            base, _, n = p.rpartition(".")
+            if n.isdigit():
+                base_paths.add(base)
+        for p in sorted(base_paths):
             coll.add(p)
         return coll
 
