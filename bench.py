@@ -746,6 +746,52 @@ def _measure_netps_transformer(name, *, num_layers, d_model, num_heads, d_ff,
         },
         "hier_curve": hier_curve,
     }
+
+    # -- sim drift: calibrate the simulator on THIS run's own trace stream
+    # and replay the deployment (distkeras_tpu.sim.calibrate). The
+    # predicted/measured throughput ratio ships in the summary so the
+    # bench-regression sentinel watches calibration rot like any other
+    # out-of-band config. One traced shot of the PR-4 flat plane (tracing
+    # adds wire bytes, so it gets its own run, not the timed variants).
+    import shutil as _shutil
+
+    from distkeras_tpu.sim.calibrate import sim_drift as _sim_drift
+    from distkeras_tpu.telemetry.tracing import context as _trace_ctx
+    from distkeras_tpu.telemetry.tracing.collector import TelemetryCollector
+
+    trace_dir = tempfile.mkdtemp(prefix="dkbench-trace-")
+    saved_env = {k: os.environ.get(k)
+                 for k in ("DKTPU_TRACE", "DKTPU_TRACE_DIR")}
+    os.environ["DKTPU_TRACE"] = "1"
+    os.environ["DKTPU_TRACE_DIR"] = trace_dir
+    _trace_ctx._reset_stream()
+    try:
+        srv = PSServer(discipline="aeasgd").start()
+        try:
+            t0 = time.perf_counter()
+            run_remote(endpoint=srv.endpoint, model=model, tx=tx,
+                       loss_fn=loss_fn, plan=plan, discipline="aeasgd",
+                       window=window, alpha=alpha,
+                       compute_dtype=jnp.bfloat16 if on_tpu else None,
+                       inflight=1, shards=1, compress="none",
+                       loop_fn=loop_fn)
+            traced_dt = time.perf_counter() - t0
+        finally:
+            srv.close()
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _trace_ctx._reset_stream()
+    try:
+        records = TelemetryCollector.from_dir(trace_dir).records()
+        rec["sim_drift"] = _sim_drift(
+            records, tokens / traced_dt,
+            tokens_per_round=window * batch * seq_len)
+    finally:
+        _shutil.rmtree(trace_dir, ignore_errors=True)
     return rec
 
 

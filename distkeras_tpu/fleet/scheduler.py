@@ -109,7 +109,9 @@ class FleetScheduler:
                  max_restarts: Optional[int] = None,
                  preemption: bool = True,
                  expansion_policy=None,
-                 health_hook=None):
+                 health_hook=None,
+                 clock=None,
+                 thread_factory=None):
         if capacity is None:
             capacity = config.env_int("DKTPU_FLEET_CAPACITY")
         if capacity < 1:
@@ -151,6 +153,15 @@ class FleetScheduler:
         #: RUNNING job's endpoint with the health target registry, so a
         #: hub on this driver discovers the fleet without configuration.
         self.health_hook = health_hook
+        #: the scheduler's timeline (grace windows, run/wait deadlines)
+        #: and its worker-thread constructor. Both injectable so the
+        #: fleet simulator (``distkeras_tpu.sim``) ticks the REAL
+        #: placement/preemption/reap logic on a virtual clock with
+        #: cooperative stand-in threads; the defaults are bit-for-bit
+        #: the previous behavior.
+        self._clock = clock if clock is not None else time.monotonic
+        self._thread_factory = (thread_factory if thread_factory
+                                is not None else threading.Thread)
         #: endpoints already acted on while down — one requeue per
         #: outage, not one per tick (cleared when the target recovers).
         self._health_acted: set = set()
@@ -311,9 +322,9 @@ class FleetScheduler:
         """Tick until every submitted job is terminal (or ``timeout``
         seconds elapse — remaining jobs are then torn down and reported
         in whatever state teardown left them). Returns :meth:`stats`."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else self._clock() + timeout
         while not self.all_terminal():
-            if deadline is not None and time.monotonic() > deadline:
+            if deadline is not None and self._clock() > deadline:
                 self.close()
                 break
             self.tick()
@@ -401,7 +412,7 @@ class FleetScheduler:
                     # quietly or the slot would leak.
                     job.error = e
 
-        thread = threading.Thread(
+        thread = self._thread_factory(
             target=body, name=f"fleet-{self._label(job)}-w{wid}")
         worker = _Worker(wid, thread)
         self._granted[job][wid] = worker
@@ -413,7 +424,7 @@ class FleetScheduler:
         if w.release.is_set():
             return
         w.release.set()
-        w.released_at = time.monotonic()
+        w.released_at = self._clock()
         if self.preempt_grace <= 0:
             self._revoke(job, w)
 
@@ -429,7 +440,7 @@ class FleetScheduler:
     def _reap(self) -> None:
         from distkeras_tpu import telemetry
 
-        now = time.monotonic()
+        now = self._clock()
         for job in self._jobs:
             workers = self._granted[job]
             for wid, w in list(workers.items()):

@@ -55,6 +55,28 @@ def check_discipline(discipline: str) -> str:
     return discipline
 
 
+def counter_scalar(counter) -> int:
+    """One scalar from a possibly per-shard counter: a sharded center's
+    pull/join returns one update counter PER SHARD; consumers mirroring a
+    single lineage counter (the hier aggregator, the simulator's
+    SimCenter) take the MIN — staleness charged from it can only be
+    overstated (DynSGD then downweights, which is safe), never
+    negative."""
+    if isinstance(counter, (tuple, list)):
+        return min(int(u) for u in counter)
+    return int(counter)
+
+
+def counter_staleness(updates, pulled) -> int:
+    """THE staleness counter rule, shared by every center implementation
+    — ``PSServer._fold_locked``, and the fleet simulator's stand-in
+    center — so simulation exercises the same arithmetic production
+    folds use: staleness is the server's update counter at fold time
+    minus the committer's pull-time counter. Either side may arrive as a
+    per-shard tuple (reduced by :func:`counter_scalar`'s MIN rule)."""
+    return counter_scalar(updates) - counter_scalar(pulled)
+
+
 def commit_scale(discipline: str, staleness: int) -> float:
     """The server-side scale applied to a commit folded ``staleness``
     updates after its pull (DynSGD's counter semantics; 1.0 otherwise)."""

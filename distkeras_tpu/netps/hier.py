@@ -48,21 +48,18 @@ from typing import Optional, Sequence
 import numpy as np
 
 from distkeras_tpu.netps.errors import NetPSError
-from distkeras_tpu.netps.fold import check_discipline, decode_entry
+from distkeras_tpu.netps.fold import (check_discipline, counter_scalar,
+                                      decode_entry)
 from distkeras_tpu.netps.server import PSServer
 from distkeras_tpu.netps.shards import make_ps_client
 from distkeras_tpu.runtime import config
 from distkeras_tpu.telemetry import tracing
 
 
-def _counter_scalar(updates) -> int:
-    """A sharded root's pull/join returns one counter PER SHARD; the
-    aggregator mirrors a single root-lineage counter locally, so take the
-    MIN — staleness charged from it can only be overstated (DynSGD then
-    downweights, which is safe), never negative."""
-    if isinstance(updates, (tuple, list)):
-        return min(int(u) for u in updates)
-    return int(updates)
+#: the per-shard -> scalar MIN reduction now lives with the rest of the
+#: counter rules in ``netps.fold`` (shared with the fleet simulator);
+#: kept under its old private name for this module's call sites.
+_counter_scalar = counter_scalar
 
 #: default seconds an under-fan-in accumulation may age before it is
 #: flushed anyway (a straggler must not hold the whole host's progress).

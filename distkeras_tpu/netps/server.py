@@ -55,9 +55,9 @@ import numpy as np
 from distkeras_tpu.netps import shm, wire
 from distkeras_tpu.netps import state as _state
 from distkeras_tpu.netps.errors import ProtocolError
-from distkeras_tpu.netps.fold import (check_discipline, decode_entry,
-                                      fold_delta, resolve_backend,
-                                      validate_delta)
+from distkeras_tpu.netps.fold import (check_discipline, counter_staleness,
+                                      decode_entry, fold_delta,
+                                      resolve_backend, validate_delta)
 from distkeras_tpu.resilience import faults as _faults
 from distkeras_tpu.runtime import config
 from distkeras_tpu.telemetry import tracing as _tracing
@@ -933,7 +933,7 @@ class PSServer:
         tail — journal append (fold order IS journal order, which is why
         this stays under the lock), snapshot-when-due, the replication
         buffer, and the commit-log bound."""
-        staleness = self._updates - int(pulled)
+        staleness = counter_staleness(self._updates, pulled)
         t0 = time.perf_counter()
         with _tracing.child_scope("commit.fold", wid=wid, seq=seq,
                                   staleness=staleness):

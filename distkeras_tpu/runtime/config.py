@@ -74,7 +74,7 @@ class EnvVar:
     default: object
     doc: str
     # "observability" | "resilience" | "network" | "fleet" | "serving" |
-    # "data" | "interop"
+    # "data" | "interop" | "sim"
     category: str
 
 
@@ -150,6 +150,20 @@ ENV_REGISTRY: dict = _declare(
            "page alert; supervisors consulting `MetricsHub.is_down` may "
            "restart it).",
            "observability"),
+    EnvVar("DKTPU_SIM_SEED", "int", 0,
+           "Default RNG seed for the fleet simulator (`distkeras_tpu.sim`): "
+           "every `SimEngine()` built without an explicit seed draws from "
+           "one `random.Random(seed)`, so two runs of the same scenario are "
+           "bit-identical. Pass `--seed` / `SimEngine(seed=...)` to "
+           "override per run.",
+           "sim"),
+    EnvVar("DKTPU_SIM_BAND_PCT", "float", 20.0,
+           "Calibration tolerance (percent) for the simulator's replay "
+           "gates: `sim_drift` and the `hier_crossover` held-out "
+           "predictions must land within this band of the measured "
+           "throughput or the gate (and the bench-regression sentinel "
+           "watching `sim_drift.within_band`) reports a miss.",
+           "sim"),
     EnvVar("DKTPU_HEALTH_SLO", "str", "",
            "SLO specs for the health plane: inline JSON (starts with `[` "
            "or `{`) or a path to a JSON file. Each spec names a hub "

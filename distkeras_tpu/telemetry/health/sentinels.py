@@ -143,7 +143,9 @@ class Sentinels:
     @staticmethod
     def bench_regressions(path: Optional[str] = None) -> List[Dict]:
         """Out-of-band configs from a BENCH_SUMMARY.json (doctored or
-        real): every config whose ``within_band`` is explicitly false."""
+        real): every config whose ``within_band`` is explicitly false —
+        including a config's nested ``sim_drift`` block (the simulator's
+        predicted-vs-measured calibration gate, same alert family)."""
         path = path or "BENCH_SUMMARY.json"
         if not os.path.exists(path):
             return []
@@ -156,6 +158,8 @@ class Sentinels:
         rows = list(summary.get("configs") or [])
         if "metric" in summary:
             rows.append(summary)
+        rows.extend([cfg["sim_drift"] for cfg in list(rows)
+                     if isinstance(cfg.get("sim_drift"), dict)])
         for cfg in rows:
             if cfg.get("within_band") is False:
                 out.append({"metric": cfg.get("metric"),

@@ -61,6 +61,9 @@ CHAOS_KINDS = frozenset({"fault_injected", "flight_dump", "netps_eviction",
 #: alignment slack (seconds) before a child-before-root timestamp counts
 #: as a clock violation — min-RTT offset estimates are good to ~rtt/2.
 SKEW_SLACK_S = 0.005
+#: sample floor below which a fitted segment distribution is flagged
+#: unreliable (lognormal mu/sigma from < this many points is noise).
+MIN_FIT_SAMPLES = 8
 
 
 def spans_of(records: Iterable[dict]) -> list[dict]:
@@ -111,6 +114,105 @@ def _quantile(sorted_vals: list, q: float) -> float:
     return sorted_vals[i]
 
 
+def commit_paths(records: Iterable[dict]) -> list[tuple]:
+    """Every commit trace's critical path: ``[(trace_id, root_span,
+    segment_durs, end_to_end_s)]`` in trace-id order. A commit trace is
+    one rooted by a ``commit`` span, or a ``hier.flush`` that carries a
+    nested ``commit`` (the aggregator's upstream hop). This is the ONE
+    commit-selection rule — :func:`trace_report` and
+    :func:`segment_model` both read it, so the report and the simulator
+    calibrate from the same population."""
+    out = []
+    for tid, t in sorted(assemble_traces(records).items()):
+        root = t["root"]
+        if root is None:
+            continue
+        name = root.get("name")
+        if name == "commit" or (name == "hier.flush" and any(
+                s.get("name") == "commit" for s in t["spans"])):
+            out.append((tid, root, _segment_durs(t["spans"]),
+                        float(root.get("dur") or 0.0)))
+    return out
+
+
+def _lognorm_fit(vals: list) -> Optional[dict]:
+    """Method-of-moments-in-log-space lognormal fit over the positive
+    samples (a zero-duration span carries no timing information)."""
+    import math
+
+    pos = [v for v in vals if v > 0.0]
+    if not pos:
+        return None
+    logs = [math.log(v) for v in pos]
+    mu = sum(logs) / len(logs)
+    var = sum((x - mu) ** 2 for x in logs) / len(logs)
+    return {"mu": mu, "sigma": math.sqrt(var), "samples": len(pos)}
+
+
+def segment_model(records: Optional[list] = None, *,
+                  commits: Optional[list] = None,
+                  min_samples: int = MIN_FIT_SAMPLES) -> dict:
+    """The per-segment quantile extraction + fitted latency model — the
+    ONE implementation behind both the ``--trace`` report's segment table
+    and the fleet simulator's calibration (``distkeras_tpu.sim``), so the
+    two can never drift.
+
+    Pass a collector-merged record list, or a precomputed
+    :func:`commit_paths` list via ``commits=``. Returns::
+
+        {"segments": {seg: {count, p50_s, p99_s, max_s, total_s, mean_s,
+                            lognorm: {mu, sigma, samples} | None,
+                            fit_ok: bool}},
+         "e2e": {count, p50_s, p99_s, mean_s} | None,
+         "commits": N, "min_samples": min_samples,
+         "warnings": ["segment 'x' has 3 samples (< 8) ..."]}
+
+    ``lognorm`` is a log-space moment fit (duration distributions are
+    multiplicative: a segment is a product of per-byte / per-row costs),
+    good enough to resample from; ``fit_ok`` is False when the segment
+    has fewer than ``min_samples`` positive samples."""
+    if commits is None:
+        commits = commit_paths(records or [])
+    seg_durs: dict = {seg: [] for seg in SEGMENT_ORDER}
+    for _tid, _root, durs, _e2e in commits:
+        for seg, d in durs.items():
+            seg_durs[seg].append(d)
+
+    segments: dict = {}
+    warnings: list[str] = []
+    for seg in SEGMENT_ORDER:
+        vals = sorted(seg_durs[seg])
+        if not vals:
+            continue
+        fit = _lognorm_fit(vals)
+        ok = bool(fit and fit["samples"] >= min_samples)
+        if not ok:
+            n = fit["samples"] if fit else 0
+            warnings.append(
+                f"segment {seg!r} has {n} positive sample(s) "
+                f"(< {min_samples}) — fit unreliable")
+        segments[seg] = {
+            "count": len(vals),
+            "p50_s": _quantile(vals, 0.50),
+            "p99_s": _quantile(vals, 0.99),
+            "max_s": vals[-1],
+            "total_s": sum(vals),
+            "mean_s": sum(vals) / len(vals),
+            "lognorm": fit,
+            "fit_ok": ok,
+        }
+
+    e2e_sorted = sorted(e for _t, _r, _d, e in commits)
+    e2e = None
+    if e2e_sorted:
+        e2e = {"count": len(e2e_sorted),
+               "p50_s": _quantile(e2e_sorted, 0.50),
+               "p99_s": _quantile(e2e_sorted, 0.99),
+               "mean_s": sum(e2e_sorted) / len(e2e_sorted)}
+    return {"segments": segments, "e2e": e2e, "commits": len(commits),
+            "min_samples": min_samples, "warnings": warnings}
+
+
 def required_segments(all_spans: list[dict]) -> frozenset:
     """The config-aware completeness bar for this stream."""
     names = {s.get("name") for s in all_spans}
@@ -136,7 +238,6 @@ def trace_report(records: list[dict]) -> dict:
     traces = assemble_traces(records)
     required = required_segments(all_spans)
 
-    commits = []          # (trace_id, root, segment_durs, end_to_end)
     orphans: list[str] = []
     skew_violations = 0
     kinds = {"pull": 0, "serve.request": 0, "hier.flush": 0,
@@ -153,28 +254,15 @@ def trace_report(records: list[dict]) -> dict:
         name = root.get("name")
         if name in kinds:
             kinds[name] += 1
-        if name == "commit" or (name == "hier.flush" and any(
-                s.get("name") == "commit" for s in t["spans"])):
-            commits.append((tid, root, _segment_durs(t["spans"]),
-                            float(root.get("dur") or 0.0)))
+    commits = commit_paths(records)
 
     complete = [c for c in commits if required <= set(c[2])]
-    seg_durs: dict = {seg: [] for seg in SEGMENT_ORDER}
-    for _tid, _root, durs, _e2e in commits:
-        for seg, d in durs.items():
-            seg_durs[seg].append(d)
-    segments = {}
-    for seg in SEGMENT_ORDER:
-        vals = sorted(seg_durs[seg])
-        if not vals:
-            continue
-        segments[seg] = {
-            "count": len(vals),
-            "p50_s": _quantile(vals, 0.50),
-            "p99_s": _quantile(vals, 0.99),
-            "max_s": vals[-1],
-            "total_s": sum(vals),
-        }
+    # The quantile extraction + fit — shared verbatim with the simulator's
+    # calibration; the report's segment table is a projection of it.
+    calibration = segment_model(commits=commits)
+    segments = {seg: {k: info[k] for k in
+                      ("count", "p50_s", "p99_s", "max_s", "total_s")}
+                for seg, info in calibration["segments"].items()}
 
     e2e_sorted = sorted(e for _t, _r, _d, e in commits)
     p99_e2e = _quantile(e2e_sorted, 0.99)
@@ -216,6 +304,7 @@ def trace_report(records: list[dict]) -> dict:
         "completeness": (len(complete) / len(commits)) if commits else None,
         "required": sorted(required),
         "segments": segments,
+        "calibration": calibration,
         "e2e_p50_s": _quantile(e2e_sorted, 0.50),
         "e2e_p99_s": p99_e2e,
         "slowest": exemplars,
@@ -272,6 +361,24 @@ def render_trace_report(rep: dict) -> str:
             w(f"{seg:<12} {h['count']:>7} {_fmt_s(h['p50_s']):>10} "
               f"{_fmt_s(h['p99_s']):>10} {_fmt_s(h['max_s']):>10} "
               f"{_fmt_s(h['total_s']):>10}\n")
+
+    cal = rep.get("calibration") or {}
+    if cal.get("segments"):
+        w("\n## Calibration (fitted segment model)\n")
+        w(f"{'segment':<12} {'samples':>8} {'mean':>10} "
+          f"{'lognorm mu':>11} {'sigma':>8}\n")
+        for seg in SEGMENT_ORDER:
+            info = cal["segments"].get(seg)
+            if info is None:
+                continue
+            fit = info.get("lognorm")
+            mu = f"{fit['mu']:.3f}" if fit else "-"
+            sigma = f"{fit['sigma']:.3f}" if fit else "-"
+            flag = "" if info.get("fit_ok") else "  (!)"
+            w(f"{seg:<12} {info['count']:>8} "
+              f"{_fmt_s(info['mean_s']):>10} {mu:>11} {sigma:>8}{flag}\n")
+        for warning in cal.get("warnings", ()):
+            w(f"WARNING: {warning}\n")
 
     if rep["slowest"]:
         w("\n## Slowest commits\n")
