@@ -1062,6 +1062,141 @@ def _measure_serving(name, *, feature_dim=64, hidden=256, num_classes=10,
     }
 
 
+def _measure_streaming(name, *, total=90, drift_at=30, num_workers=2,
+                       k=2, batch=16, feature_dim=4, num_classes=3,
+                       checkpoint_every=8):
+    """Config #11 — the streaming continual-training loop, measured as a
+    fleet tenant under chaos: a :class:`StreamingTraining` job on a
+    :class:`FleetScheduler` pool ingests a throttled socket feed whose
+    labels drift at record ``drift_at`` and whose connection is severed
+    mid-run, while a :class:`ModelRegistry` hot-swaps its checkpoints
+    through the drift watch's regression gate. The headline value is
+    committed items/s; the deliverables next to it are the loop-closure
+    numbers — event-to-served-weight freshness (p50/p99 across swaps)
+    and time-to-recover after the injected drift (page -> clear)."""
+    import os as _os
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from distkeras_tpu import telemetry
+    from distkeras_tpu.fleet import DONE, FleetJob, FleetScheduler
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.ops.losses import get_loss
+    from distkeras_tpu.ops.optimizers import get_optimizer
+    from distkeras_tpu.resilience import faults
+    from distkeras_tpu.resilience.faults import FaultPlan
+    from distkeras_tpu.serving import ModelRegistry
+    from distkeras_tpu.streaming import (
+        DriftWatch,
+        SocketSource,
+        StreamingTraining,
+        StreamProducer,
+        WindowedEval,
+    )
+
+    def build():
+        return Model.build(MLP(hidden=(16,), num_outputs=num_classes),
+                           np.zeros((1, feature_dim), np.float32), seed=0)
+
+    rng = np.random.default_rng(7)
+    centers = rng.normal(scale=4.0, size=(num_classes, feature_dim))
+
+    def blob(prng, kk, bb):
+        y = prng.integers(0, num_classes, size=(kk, bb))
+        x = (centers[y] + prng.normal(scale=0.5, size=(kk, bb, feature_dim))
+             ).astype(np.float32)
+        return x, y.astype(np.int32)
+
+    xh, yh = blob(rng, 1, 64)
+    xh, yh_drift = xh[0], ((yh[0] + 1) % num_classes).astype(np.int32)
+
+    base = tempfile.mkdtemp(prefix="dktpu-bench-stream-")
+    ckpt_dir = _os.path.join(base, "ckpt")
+    faults.set_plan(FaultPlan.parse(
+        f"feed_gap@8:0.2;drift@{drift_at};seed=3"))
+    prod = StreamProducer()
+    watch = DriftWatch(window=WindowedEval(fast=8, slow=40))
+    rt = StreamingTraining(
+        model=build(), tx=get_optimizer("sgd", 0.1),
+        loss_fn=get_loss("sparse_categorical_crossentropy"),
+        source=SocketSource(prod.endpoint, drift_classes=num_classes),
+        num_workers=num_workers, discipline="adag", seed=0,
+        journal=_os.path.join(base, "offsets.json"),
+        checkpoint_dir=ckpt_dir, checkpoint_every=checkpoint_every,
+        drift_watch=watch, max_pending=8)
+
+    def produce():
+        prng = np.random.default_rng(11)
+        t0 = time.monotonic()
+        for i in range(total):
+            while (i - rt.progress() > 24
+                   and time.monotonic() - t0 < 240):
+                time.sleep(0.02)
+            xs, ys = blob(prng, k, batch)
+            prod.feed(xs, ys)
+            if i == total // 2:
+                # Sever the live feed mid-run: reconnect-and-resume is
+                # part of the measured steady state, not a free pass.
+                prod.kill_connections()
+        prod.end()
+
+    def held_out_loss(cand):
+        logits = np.asarray(cand.infer((xh,)), np.float64)
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        return float(-logp[np.arange(len(yh_drift)), yh_drift].mean())
+
+    registry = ModelRegistry(
+        build(), (64,), directory=ckpt_dir, poll_s=0.1,
+        quality_gate=watch.regression_gate(held_out_loss,
+                                           regress_floor=0.5))
+    registry.start()
+    sched = FleetScheduler(capacity=num_workers, tick_s=0.02)
+    job = sched.submit(FleetJob("stream", "bench", rt, priority=0,
+                                min_gang=1, max_workers=num_workers))
+    threading.Thread(target=produce, daemon=True).start()
+    t0 = time.perf_counter()
+    sched.start()
+    try:
+        ok = sched.wait(timeout=420)
+        dt = time.perf_counter() - t0
+    finally:
+        sched.close()
+        registry.close()
+        prod.close()
+        faults.reset()
+    if not ok or job.state != DONE or rt.errors:
+        raise RuntimeError(
+            f"streaming bench did not drain: state={job.state} "
+            f"errors={rt.errors[:2]}")
+    registry.poll_once()
+    bm, version = registry.current()
+    acc = float((np.asarray(bm.infer((xh,))).argmax(-1)
+                 == yh_drift).mean())
+    fresh = sorted(e["seconds"] for e in telemetry.get().events()
+                   if e["kind"] == "serve_freshness")
+    n = len(fresh)
+    return {
+        "metric": f"{name}_items_per_sec",
+        "value": round(total / dt, 2) if dt > 0 else None,
+        "unit": "items/s",
+        "items": total,
+        "drift_recovery_s": (round(watch.last_recovery_s, 3)
+                             if watch.last_recovery_s is not None else None),
+        "drift_events": watch.drift_events,
+        "freshness_p50_s": round(fresh[n // 2], 3) if n else None,
+        "freshness_p99_s": (round(fresh[min(n - 1, int(n * 0.99))], 3)
+                            if n else None),
+        "swaps": n,
+        "served_step": version,
+        "served_acc_drifted": round(acc, 4),
+        "source_reconnects":
+            int(telemetry.get().counter("stream.source_reconnects").value),
+    }
+
+
 def scaling_sweep():
     """The north-star gate's measurement machinery (BASELINE.md #3): CIFAR-10
     CNN under AEASGD at num_workers = 1, 2, 4, ..., N over the visible devices,
@@ -1332,6 +1467,15 @@ def main():
                          cols=512 if on_tpu else 256,
                          workers=4, commits=6 if on_tpu else 4)))
 
+    # 11 - the streaming continual-training loop as a fleet tenant under
+    # chaos (feed gap + injected concept drift + severed feed): committed
+    # items/s headline, with the loop-closure numbers next to it —
+    # event-to-served-weight freshness p50/p99 at hot-swap and
+    # time-to-recover after drift@R (page -> clear). Host/IO bound by
+    # design; the same size runs on CPU CI and on-chip.
+    configs.append(("streaming_loop", None, "streaming",
+                    dict(total=90, drift_at=30, num_workers=2)))
+
     # Optional subset for debugging: BENCH_CONFIGS=cifar10,resnet python bench.py
     only = [s for s in os.environ.get("BENCH_CONFIGS", "").split(",") if s]
     if only:
@@ -1359,6 +1503,8 @@ def main():
                         rec = _measure_serving(name, **kw)
                     elif discipline == "sharded_center":
                         rec = _measure_sharded_center(name, **kw)
+                    elif discipline == "streaming":
+                        rec = _measure_streaming(name, **kw)
                     else:
                         rec = _measure(name, model_fn, discipline, **kw)
                 break

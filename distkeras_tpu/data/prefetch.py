@@ -8,18 +8,35 @@ async, so the main loop's only synchronous cost becomes a queue pop.
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
-from typing import Callable, Iterator, Optional
+from typing import Callable, Iterable, Iterator, Optional, Union
 
 from distkeras_tpu.runtime import config
 
+#: how many per-round consumer waits :attr:`RoundFeeder.waits` retains.
+#: Open-ended streams run forever; an unbounded ``list[float]`` is a slow
+#: memory leak, so the tail is a deque and the *sum* is kept separately
+#: (``wait_seconds``) so total-stall accounting never loses evicted entries.
+WAITS_KEEP = 4096
+
 
 class RoundFeeder:
-    """Iterate ``(r, staged_batch)`` over a BatchPlan with lookahead.
+    """Iterate ``(r, staged_batch)`` over a work-item source with lookahead.
 
-    ``stage(r) -> batch`` does the gather + device_put for round ``r``; it runs on
+    ``items`` is either an int N (the classic bounded mode: item indices
+    ``start_round..N``, ``stage(r)`` receives the index) or any iterable —
+    including an **unbounded** one (a live stream source): the feeder
+    enumerates it and ``stage(item)`` receives each yielded item, while the
+    ``r`` handed to the consumer is the item's ordinal (``start_round`` +
+    position), which is also the index fault injection addresses. Epoch
+    bookkeeping therefore lives entirely in the caller; this class only
+    knows "next item", which is what lets the engine run loops accept a
+    stream that never ends.
+
+    ``stage(r_or_item) -> batch`` does the gather + device_put; it runs on
     the feeder thread. Exceptions propagate to the consumer on the next pop.
 
     Abandonment-safe: if the consumer stops iterating early (``engine.run``
@@ -50,13 +67,15 @@ class RoundFeeder:
       index under blocked execution).
     """
 
-    def __init__(self, num_rounds: int, stage: Callable[[int], object],
+    def __init__(self, items: Union[int, Iterable], stage: Callable,
                  start_round: int = 0, depth: int = 2,
                  stall_timeout: Optional[float] = None,
                  stall_warn: Optional[float] = None,
                  stage_retries: Optional[int] = None,
                  retry_backoff_s: float = 0.05):
-        self.num_rounds = num_rounds
+        self.items = items
+        #: bounded-mode round count (None in iterable mode).
+        self.num_rounds = items if isinstance(items, int) else None
         self.stage = stage
         self.start_round = start_round
         self.depth = max(1, depth)
@@ -74,9 +93,14 @@ class RoundFeeder:
         #: the feed-overlap diagnostic. Because jax dispatch is async, the
         #: consumer loop runs ahead of the device; per-round waits beyond
         #: the warmup round mean the gather+transform+device_put pipeline
-        #: is slower than the dispatch loop (staging NOT hidden). Summed by
-        #: the engine run loops into ``engine.feed_wait_seconds``.
-        self.waits: list[float] = []
+        #: is slower than the dispatch loop (staging NOT hidden). Bounded
+        #: (last :data:`WAITS_KEEP` entries) so open-ended streams do not
+        #: leak; :attr:`wait_seconds` keeps the exact running total the
+        #: engine run loops surface as ``engine.feed_wait_seconds``.
+        self.waits: collections.deque = collections.deque(maxlen=WAITS_KEEP)
+        #: exact sum of EVERY recorded wait, including entries the bounded
+        #: :attr:`waits` deque has already evicted.
+        self.wait_seconds: float = 0.0
 
     def _put(self, item) -> bool:
         """Blocking put that aborts (returns False) once close() is called."""
@@ -88,8 +112,10 @@ class RoundFeeder:
                 continue
         return False
 
-    def _stage_once(self, r: int):
-        """One stage attempt, with scheduled fault injection applied first."""
+    def _stage_once(self, r: int, item):
+        """One stage attempt, with scheduled fault injection applied first.
+        ``r`` is the ordinal the fault plan indexes by; ``item`` is what the
+        stage callback receives (== r in bounded mode)."""
         from distkeras_tpu.resilience import faults
 
         plan = faults.active_plan()
@@ -102,13 +128,13 @@ class RoundFeeder:
 
                 raise InjectedFault(
                     f"feeder error injected at item {r} (DKTPU_FAULTS)")
-        return self.stage(r)
+        return self.stage(item)
 
-    def _stage_with_retry(self, r: int, tele):
+    def _stage_with_retry(self, r: int, item, tele):
         attempt = 0
         while True:
             try:
-                return self._stage_once(r)
+                return self._stage_once(r, item)
             except Exception:
                 # Only plain Exceptions retry: KeyboardInterrupt/SystemExit
                 # and close() must still win immediately.
@@ -118,17 +144,28 @@ class RoundFeeder:
                 time.sleep(self.retry_backoff_s * (2 ** attempt))
                 attempt += 1
 
+    def _item_source(self) -> Iterator:
+        """``(ordinal, item)`` pairs: a range in bounded mode, an enumerate
+        of the caller's iterable (offset by ``start_round`` so resume keeps
+        fault/ckpt indices stable) in stream mode."""
+        if self.num_rounds is not None:
+            for r in range(self.start_round, self.num_rounds):
+                yield r, r
+        else:
+            for i, item in enumerate(self.items):
+                yield self.start_round + i, item
+
     def _run(self):
         from distkeras_tpu import telemetry
 
         tele = telemetry.get()
         stage_span = tele.histogram("feeder.stage")
         try:
-            for r in range(self.start_round, self.num_rounds):
+            for r, item in self._item_source():
                 if self._stop.is_set():
                     return
                 t0 = time.perf_counter()
-                batch = self._stage_with_retry(r, tele)
+                batch = self._stage_with_retry(r, item, tele)
                 # Producer-side cost (gather + transform + device_put), the
                 # counterpart of the consumer's ``input_stall``: staging
                 # slower than dispatch is what makes stalls appear.
@@ -231,6 +268,7 @@ class RoundFeeder:
                 depth_gauge.set(q)
                 fill_gauge.set(q / self.depth)
                 self.waits.append(wait)
+                self.wait_seconds += wait
                 wait = 0.0
                 yield r, batch
         finally:

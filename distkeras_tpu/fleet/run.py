@@ -43,6 +43,7 @@ from distkeras_tpu.data.batching import BatchPlan
 from distkeras_tpu.netps.fold import check_discipline
 from distkeras_tpu.netps.shards import make_ps_client
 from distkeras_tpu.resilience import faults as _faults
+from distkeras_tpu.streaming.items import WorkQueue
 
 
 class ElasticTraining:
@@ -92,11 +93,11 @@ class ElasticTraining:
         self.errors: list = []
         self._lock = threading.Lock()
         #: work items are (round, slice) pairs flattened round-major:
-        #: item i = (i // W, i % W) — the plan's full schedule.
+        #: item i = (i // W, i % W) — the plan's full schedule, as a
+        #: bounded WorkQueue (the claim/requeue/commit discipline shared
+        #: with the open-ended streaming runtime).
         self._total_items = plan.num_rounds * plan.num_workers
-        self._next_item = 0
-        self._retry: collections.deque = collections.deque()
-        self._committed = 0
+        self._queue = WorkQueue(total=self._total_items)
         self._applied = 0
         self._stale = collections.deque(maxlen=256)
         self._started = False
@@ -153,8 +154,7 @@ class ElasticTraining:
         return self._applied
 
     def done(self) -> bool:
-        with self._lock:
-            return self._committed >= self._total_items
+        return self._queue.done()
 
     def revoke(self, worker_id: int) -> None:
         """Lease revocation — the preemption primitive. In-process
@@ -171,7 +171,7 @@ class ElasticTraining:
         if self._closed:
             return
         self._closed = True
-        if self._endpoint is not None and self._committed > 0:
+        if self._endpoint is not None and self._queue.committed > 0:
             try:
                 with make_ps_client(self._endpoint,
                                     **self._client_kw) as obs:
@@ -198,32 +198,21 @@ class ElasticTraining:
 
     def _claim(self, should_run) -> Optional[int]:
         """The next work item to process: the retry queue first, then the
-        frontier. Blocks (politely) while other workers' claims are still
-        in flight — exiting early would strand a requeued item."""
-        while should_run():
-            with self._lock:
-                if self._retry:
-                    return self._retry.popleft()
-                if self._next_item < self._total_items:
-                    i = self._next_item
-                    self._next_item += 1
-                    return i
-                if self._committed >= self._total_items:
-                    return None
-            time.sleep(0.01)
-        return None
+        frontier (:class:`WorkQueue` in bounded mode). Blocks (politely)
+        while other workers' claims are still in flight — exiting early
+        would strand a requeued item."""
+        return self._queue.claim(should_run)
 
     def _requeue(self, item: int) -> None:
-        with self._lock:
-            self._retry.append(item)
+        self._queue.requeue(item)
 
     def _commit_done(self, r: int, s: int, loss: float,
                      staleness: int) -> None:
         from distkeras_tpu import telemetry
 
         suffix = telemetry.label_suffix()
+        self._queue.commit_one()
         with self._lock:
-            self._committed += 1
             self._applied += 1
             self.losses[r, s] = loss
             if staleness >= 0:

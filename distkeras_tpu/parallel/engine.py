@@ -497,6 +497,14 @@ class AsyncEngine:
         return run_rounds(self, plan, state, start_round, on_round,
                           rounds_per_program)
 
+    def run_stream(self, items, state=None, on_item=None, start_index=0,
+                   max_items=None):
+        """Train on an open-ended batch source (``(xs, ys)`` host batches
+        shaped ``[W, K, B, ...]`` like one BatchPlan round) — no epoch
+        schedule, no round count; see :func:`run_stream`."""
+        return run_stream(self, items, state=state, on_item=on_item,
+                          start_index=start_index, max_items=max_items)
+
 
 def local_worker_ids(mesh, workers_per_chip: int = 1) -> list[int]:
     """Global LOGICAL worker ids whose chips THIS process hosts (1-D data
@@ -689,7 +697,10 @@ def _record_feed_waits(engine, feeder) -> None:
     from distkeras_tpu import telemetry
 
     engine.feed_waits = list(feeder.waits)
-    engine.feed_wait_seconds = float(sum(feeder.waits))
+    # The running sum, NOT sum(waits): the per-round deque is bounded
+    # (prefetch.WAITS_KEEP) and an open-ended stream evicts old entries —
+    # the total must keep counting them.
+    engine.feed_wait_seconds = float(feeder.wait_seconds)
     tele = telemetry.get()
     stall = tele.histogram("input_stall")
     for w in feeder.waits:
@@ -749,6 +760,79 @@ def run_per_round(engine, plan, state, start_round, on_round):
     with tele.span("retire[per-round]"):
         host = jax.device_get(losses)
     return state, np.asarray(host)
+
+
+def run_stream(engine, items, state=None, on_item=None, start_index=0,
+               max_items=None, stage=None, fetch_every=64):
+    """Run an **open-ended** item source through an engine's round function.
+
+    Where :func:`run_per_round` walks a BatchPlan's fixed epoch schedule,
+    this loop has no epoch bookkeeping at all: ``items`` is any iterable of
+    host batches ``(xs, ys)`` — including an unbounded live stream — staged
+    through the same :class:`RoundFeeder` lookahead/backpressure (so stream
+    stalls hit the stall watchdog and surface as ``FeederStalledError``,
+    exactly like a dried-up BatchPlan gather). Both the sync and async
+    engines run through here unchanged: each only needs its
+    ``_round_fn(state, xs, ys)``.
+
+    ``on_item(i, loss, state)`` sees the *device* loss (no fence).
+    ``max_items`` bounds consumption of an endless source (tests, bounded
+    sessions); losses are fetched to host in ``fetch_every`` chunks so an
+    unbounded run holds O(fetch_every) device scalars, not O(items).
+    Returns ``(state, host_losses)`` for the items actually consumed.
+    """
+    import itertools
+
+    from distkeras_tpu import telemetry
+    from distkeras_tpu.data.prefetch import RoundFeeder
+    from distkeras_tpu.resilience.guard import RoundGuard, note_losses
+
+    tele = telemetry.get()
+    guard = RoundGuard(engine)
+    if state is None:
+        state = engine.init_state()
+    if max_items is not None:
+        items = itertools.islice(items, max_items)
+    stage = stage or (lambda batch: engine._put_batch(*batch))
+    host: list = []
+    pending: list = []
+
+    def _drain():
+        if pending:
+            host.extend(np.ravel(np.asarray(jax.device_get(pending))))
+            pending.clear()
+
+    feeder = RoundFeeder(items, stage, start_round=start_index)
+    with tele.span("engine_run"):
+        try:
+            for i, (xs, ys) in feeder:
+                guard.pre_round(i)  # crash/kill fault injection
+                with tele.span("dispatch[stream]"):
+                    new_state, loss = engine._round_fn(state, xs, ys)
+                pending.append(loss)
+                if on_item is not None:
+                    on_item(i, loss, new_state)
+                state = guard.post_round(i, loss, new_state)
+                if len(pending) >= fetch_every:
+                    # Incremental fetch: bounds live device scalars AND is
+                    # the only fence an endless run ever takes.
+                    with tele.span("retire[stream]"):
+                        _drain()
+        except BaseException:
+            import contextlib
+
+            with contextlib.suppress(Exception):
+                _drain()
+                note_losses(np.asarray(host))
+            raise
+        finally:
+            feeder.close()
+            _record_feed_waits(engine, feeder)
+    with tele.span("retire[stream]"):
+        _drain()
+    losses = np.asarray(host, np.float32)
+    note_losses(losses)
+    return state, losses
 
 
 #: auto-R sizing. The probe must measure the STEADY-STATE per-round cost:

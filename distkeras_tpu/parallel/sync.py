@@ -209,3 +209,14 @@ class SyncEngine:
 
         return run_rounds(self, plan, state, start_round, on_round,
                           rounds_per_program)
+
+    def run_stream(self, items, state=None, on_item=None, start_index=0,
+                   max_items=None):
+        """Train on an open-ended batch source (``(xs, ys)`` host batches
+        shaped ``[W, K, B, ...]``) — same contract as
+        :meth:`AsyncEngine.run_stream`; epoch bookkeeping stays with the
+        caller."""
+        from distkeras_tpu.parallel.engine import run_stream
+
+        return run_stream(self, items, state=state, on_item=on_item,
+                          start_index=start_index, max_items=max_items)

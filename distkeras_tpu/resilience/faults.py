@@ -27,6 +27,15 @@ Syntax (``;``-separated entries)::
 ``ckpt_corrupt@S`` scribble over the checkpoint payload of Orbax step S
                    right after it is written (exercises the hash-sidecar
                    fallback restore)
+``feed_gap@R:S``   the stream source goes silent for S seconds before
+                   delivering item R (``streaming/source.py``) — upstream
+                   of the staging thread, so the gap flows through the
+                   RoundFeeder stall watchdog exactly like a real dried-up
+                   feed
+``drift@R``        distribution shift injected at stream item R: every
+                   record from R onward has its labels deterministically
+                   rotated (``streaming/source.py``), so windowed online
+                   eval loss diverges and the drift sentinel must page
 ``seed=N``         seeds deterministic choices (which worker's batch rows
                    get poisoned)
 =================  ==========================================================
@@ -54,6 +63,7 @@ from distkeras_tpu.runtime import config
 #: fault kinds and whether they take an argument.
 _KINDS = frozenset({
     "nan", "inf", "stall", "feeder_error", "crash", "kill", "ckpt_corrupt",
+    "feed_gap", "drift",
 })
 
 #: network fault kinds (``DKTPU_NET_FAULTS``), consumed by the netps chaos
@@ -250,6 +260,21 @@ class FaultPlan:
 
     def ckpt_corrupt(self, step: int) -> bool:
         return self._fire("ckpt_corrupt", step) is not None
+
+    def feed_gap(self, item: int) -> float:
+        """Seconds the stream source should go silent before delivering
+        ``item`` (0 = no fault) — the dried-up-feed drill, consumed by the
+        source layer so the gap propagates through staging into the
+        RoundFeeder stall watchdog."""
+        arg = self._fire("feed_gap", item)
+        return float(arg) if arg else 0.0
+
+    def drift(self, item: int) -> bool:
+        """Whether a distribution shift is scheduled to begin at stream
+        ``item``. One-shot like every fault, but the *shift* is permanent:
+        the source remembers the trigger and keeps transforming every
+        subsequent record (a drifted world does not un-drift by itself)."""
+        return self._fire("drift", item) is not None
 
     def poison_worker(self, round_idx: int, num_workers: int) -> int:
         """Deterministic (seeded) choice of which worker's rows to poison —

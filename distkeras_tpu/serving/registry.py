@@ -18,11 +18,27 @@ A candidate that fails restore or probe is remembered and skipped
 (``serving.swap_failures``); the registry falls back to the next-newest
 candidate, mirroring ``Trainer._resume_from_checkpoint``'s corruption
 fallback, and keeps serving the incumbent either way.
+
+Two streaming-loop extensions:
+
+* ``quality_gate`` — an optional ``gate(candidate, step) -> bool``
+  called after the probe and before the swap (e.g.
+  :meth:`DriftWatch.regression_gate`, which scores the candidate on
+  held-out recent data). A refusal is **rollback-on-regression**: the
+  step joins ``_failed`` (``serving.swap_rejected_regression``) and the
+  incumbent keeps serving.
+* **Freshness at swap**: when the candidate's checkpoint meta carries
+  ``event_ts`` (the newest stream-event timestamp folded into those
+  weights — the streaming trainer writes it), the registry records
+  event-to-served-weight freshness (``serving.freshness`` histogram,
+  ``serving.freshness_s`` gauge) at the swap instant — the
+  close-the-loop metric the streaming bench reports.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 from distkeras_tpu import checkpoint as ckpt_mod
@@ -36,8 +52,12 @@ class ModelRegistry:
     polling thread that hot-swaps newer verified checkpoints in."""
 
     def __init__(self, model, buckets, directory: Optional[str] = None,
-                 poll_s: Optional[float] = None, warmup: bool = True):
+                 poll_s: Optional[float] = None, warmup: bool = True,
+                 quality_gate=None):
         self.directory = directory
+        #: optional ``gate(candidate: BucketedModel, step) -> bool`` run
+        #: after the warmup probe; False refuses the swap permanently.
+        self.quality_gate = quality_gate
         self.poll_s = float(config.env_float("DKTPU_SERVE_POLL_S")
                             if poll_s is None else poll_s)
         self._model = model
@@ -111,13 +131,41 @@ class ModelRegistry:
                     f"({type(e).__name__}: {e}); still serving version "
                     f"{self._version}", stacklevel=2)
                 continue
+            if self.quality_gate is not None:
+                try:
+                    ok = bool(self.quality_gate(candidate, step))
+                except Exception:  # noqa: BLE001 - a broken gate rejects
+                    ok = False
+                if not ok:
+                    self._failed.add(step)
+                    telemetry.counter(
+                        "serving.swap_rejected_regression").add(1)
+                    telemetry.event("serve_swap_rejected", {"step": step})
+                    continue
             with self._lock:
                 self._bucketed = candidate
                 self._version = step
             telemetry.counter("serving.swaps").add(1)
             telemetry.event("serve_swap", {"step": step})
+            self._note_freshness(step)
             return True
         return False
+
+    def _note_freshness(self, step: int) -> None:
+        """Event-to-served-weight freshness: now minus the newest stream
+        event folded into the just-swapped weights (meta ``event_ts``,
+        written by the streaming trainer; absent for batch checkpoints)."""
+        from distkeras_tpu import telemetry
+
+        meta = ckpt_mod.read_meta(self.directory, step) or {}
+        event_ts = meta.get("event_ts")
+        if event_ts is None:
+            return
+        fresh = max(0.0, time.time() - float(event_ts))
+        telemetry.gauge("serving.freshness_s").set(round(fresh, 3))
+        telemetry.histogram("serving.freshness").observe(fresh)
+        telemetry.event("serve_freshness", {
+            "step": step, "seconds": round(fresh, 3)})
 
     def _load_and_probe(self, step: int) -> BucketedModel:
         """Restore ``step`` (digest-verified) into the model's parameter

@@ -1,0 +1,64 @@
+"""Streaming continual training: the online-learning loop, closed.
+
+The reference's ``Trainer.train(dataframe)`` is batch-shaped; production
+traffic is a stream. This package is the connective tissue between the
+pieces the repo already has — the netps parameter server, the elastic
+claim queue, checkpoint/restore, the serving ``ModelRegistry``'s hot
+swap, and the health plane's drift sentinels — turned into one loop::
+
+    source -> RoundFeeder staging -> claim queue -> train -> commit(PS)
+       ^                                              |
+       |            OffsetJournal (durable)  <--------+
+       |                                              v
+    resume at last committed offset     checkpoint -> hot-swap -> serve
+
+* :mod:`~distkeras_tpu.streaming.source` — the :class:`StreamSource`
+  contract (file tail + socket feed) with fault injection
+  (``feed_gap@R:S``, ``drift@R``) and a record codec.
+* :mod:`~distkeras_tpu.streaming.journal` — the durable
+  :class:`OffsetJournal`: the exactly-once ingest argument lives there.
+* :mod:`~distkeras_tpu.streaming.items` — :class:`WorkQueue`, the claim
+  queue generalized to open-ended item streams (ElasticTraining's fixed
+  ``rounds x W`` schedule is the bounded special case).
+* :mod:`~distkeras_tpu.streaming.evaluate` — windowed online eval +
+  :class:`DriftWatch` (loss-divergence pages via ``AlertManager``,
+  checkpoint-on-drift, recovery timing).
+* :mod:`~distkeras_tpu.streaming.runtime` — :class:`StreamingTraining`,
+  the fleet-schedulable runtime tying it together, and
+  :class:`StreamingSession`, the Supervisor-compatible wrapper.
+
+docs/STREAMING.md is the narrative: source contract, the offset-journal
+exactly-once argument, the drift -> page -> checkpoint -> rollback
+lifecycle, and the failure matrix.
+"""
+
+from distkeras_tpu.streaming.evaluate import DriftWatch, WindowedEval
+from distkeras_tpu.streaming.items import WorkQueue
+from distkeras_tpu.streaming.journal import OffsetJournal, replayed_offsets
+from distkeras_tpu.streaming.runtime import StreamingSession, StreamingTraining
+from distkeras_tpu.streaming.source import (
+    FileTailSource,
+    SocketSource,
+    StreamFileWriter,
+    StreamProducer,
+    StreamRecord,
+    decode_record,
+    encode_record,
+)
+
+__all__ = [
+    "DriftWatch",
+    "FileTailSource",
+    "OffsetJournal",
+    "SocketSource",
+    "StreamFileWriter",
+    "StreamProducer",
+    "StreamRecord",
+    "StreamingSession",
+    "StreamingTraining",
+    "WindowedEval",
+    "WorkQueue",
+    "decode_record",
+    "encode_record",
+    "replayed_offsets",
+]

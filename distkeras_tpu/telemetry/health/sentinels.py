@@ -41,6 +41,8 @@ class Sentinels:
     round_floor_s: float = 0.05
     journal_floor_s: float = 0.02
     shed_rate_floor: float = 0.5  # sheds/s in the fast window
+    #: streaming eval loss under this is converged noise, not drift.
+    stream_loss_floor: float = 0.05
 
     def __init__(self, alerts: Optional[AlertManager] = None,
                  bench_summary: Optional[str] = None,
@@ -64,6 +66,11 @@ class Sentinels:
                     self.round_floor_s)
         self._drift(hub, "journal_lag", "netps.journal.*", "span_mean",
                     self.journal_floor_s)
+        # Fleet-level mirror of the in-runtime DriftWatch: the streaming
+        # trainer's fast-window eval loss climbing against its own trailing
+        # history is drift visible from the health plane alone.
+        self._drift(hub, "stream_loss_divergence", "stream.eval.loss_fast",
+                    "mean", self.stream_loss_floor)
         self._shed_spike(hub)
         self._bench_regression(hub)
 

@@ -226,6 +226,71 @@ def serving_summary(summary: dict) -> Optional[dict]:
     return out or None
 
 
+def _sum_prefixed(table: dict, base: str) -> Optional[float]:
+    """Sum ``base`` plus every tenant-suffixed variant (``base.<tenant>.
+    <job>``) — streaming metrics carry the fleet label suffix when the
+    trainer runs as a tenant."""
+    total, found = 0.0, False
+    for name, v in table.items():
+        if name == base or name.startswith(base + "."):
+            total += float(v)
+            found = True
+    return total if found else None
+
+
+#: ``stream.*`` counters surfaced in the Streaming report section.
+_STREAMING_COUNTERS = (
+    ("stream.items_read", "items_read"),
+    ("stream.items_committed", "items_committed"),
+    ("stream.requeued", "requeued"),
+    ("stream.drift_events", "drift_events"),
+    ("stream.drift_injected", "drift_injected"),
+    ("stream.source_reconnects", "source_reconnects"),
+)
+
+
+def streaming_summary(summary: dict) -> Optional[dict]:
+    """Roll up the streaming loop's metrics: ingest accounting (read /
+    committed / requeued), offset lag, drift detections and recovery
+    time, the windowed-eval means, and event-to-served-weight freshness
+    quantiles from the ``serving.freshness`` histogram (recorded at each
+    hot-swap). None when the run streamed nothing."""
+    out: dict = {}
+    counters = summary.get("counters", {})
+    gauges = summary.get("gauges", {})
+    for base, key in _STREAMING_COUNTERS:
+        v = _sum_prefixed(counters, base)
+        if v is not None:
+            out[key] = v
+    lag = gauges.get("stream.offset_lag")
+    if lag is not None:
+        out["offset_lag_last"] = lag.get("value")
+        out["offset_lag_max"] = lag.get("max")
+    for name, key in (("stream.recovery_seconds", "recovery_s"),
+                      ("stream.eval.loss_fast", "eval_loss_fast"),
+                      ("stream.eval.loss_slow", "eval_loss_slow"),
+                      ("stream.candidate_loss", "candidate_loss")):
+        g = gauges.get(name)
+        if g is not None:
+            out[key] = g.get("value")
+    stale = [g.get("value") for n, g in gauges.items()
+             if n == "stream.staleness_mean"
+             or n.startswith("stream.staleness_mean.")]
+    stale = [s for s in stale if s is not None]
+    if stale:
+        out["staleness_mean"] = max(stale)
+    fresh = summary.get("spans", {}).get("serving.freshness")
+    if fresh and fresh.get("count"):
+        out["freshness_count"] = fresh["count"]
+        out["freshness_p50_s"] = _hist_quantile(fresh, 0.50)
+        out["freshness_p99_s"] = _hist_quantile(fresh, 0.99)
+        out["freshness_max_s"] = _hist_max(fresh)
+    rejected = counters.get("serving.swap_rejected_regression")
+    if rejected is not None:
+        out["swaps_rejected_regression"] = rejected
+    return out or None
+
+
 def shard_summary(summary: dict) -> Optional[dict]:
     """Roll up the sharded center plane's metrics: per-shard fold/byte
     counters (``netps.shard.folds.<k>`` / ``netps.shard.bytes.<k>``), the
@@ -360,6 +425,7 @@ def build_report(path: str, k: float = STRAGGLER_K) -> dict:
         "stragglers": straggler_table(rounds, k),
         "fleet": fleet_attribution(merged),
         "serving": serving_summary(merged),
+        "streaming": streaming_summary(merged),
         "shards": shard_summary(merged),
         "tuner": tuner_summary(records, merged),
         "losses": [r["loss"] for r in rounds if "loss" in r],
@@ -463,6 +529,39 @@ def render_report(report: dict) -> str:
           f"({sv.get('swap_failures', 0):.0f} rejected)   "
           f"retraces after warmup: "
           f"{sv.get('retrace_after_warmup', 0):.0f}\n")
+
+    if report.get("streaming"):
+        st = report["streaming"]
+        w("\n## Streaming\n")
+        w(f"items: read {st.get('items_read', 0):.0f}   "
+          f"committed {st.get('items_committed', 0):.0f}   "
+          f"requeued {st.get('requeued', 0):.0f}\n")
+        if "offset_lag_last" in st:
+            w(f"offset lag: last {st['offset_lag_last']:.0f}   "
+              f"max {st.get('offset_lag_max', 0):.0f}\n")
+        if st.get("drift_events") is not None or \
+                st.get("drift_injected") is not None:
+            w(f"drift: detected {st.get('drift_events', 0):.0f}   "
+              f"injected {st.get('drift_injected', 0):.0f}")
+            if st.get("recovery_s") is not None:
+                w(f"   last recovery {_fmt_seconds(st['recovery_s'])}")
+            w("\n")
+        if st.get("eval_loss_fast") is not None:
+            w(f"windowed eval loss: fast {st['eval_loss_fast']:.4f}   "
+              f"slow {st.get('eval_loss_slow', float('nan')):.4f}\n")
+        if "freshness_count" in st:
+            w(f"event-to-served-weight freshness: "
+              f"p50 {_fmt_seconds(st['freshness_p50_s'])}   "
+              f"p99 {_fmt_seconds(st['freshness_p99_s'])}   "
+              f"max {_fmt_seconds(st['freshness_max_s'])} "
+              f"({st['freshness_count']:.0f} swaps)\n")
+        for key, label in (("source_reconnects", "source reconnects"),
+                           ("swaps_rejected_regression",
+                            "swaps rejected (regression)")):
+            if st.get(key):
+                w(f"{label}: {st[key]:.0f}\n")
+        if st.get("staleness_mean") is not None:
+            w(f"staleness mean: {st['staleness_mean']:.2f}\n")
 
     if report.get("shards"):
         sh = report["shards"]

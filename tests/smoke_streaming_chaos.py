@@ -1,0 +1,452 @@
+"""CI streaming-chaos smoke (not a pytest module — run directly).
+
+Two legs closing the ISSUE's online loop — ingest -> train -> checkpoint
+-> hot-swap -> serve — under chaos:
+
+**Leg 1 (fleet colocation):** a :class:`StreamingTraining` tenant and a
+batch :class:`ElasticTraining` tenant share ONE worker pool under the
+:class:`FleetScheduler`, while a :class:`ModelRegistry` polls the
+streaming tenant's checkpoint directory and hot-swaps candidates through
+a :meth:`DriftWatch.regression_gate` quality gate. Chaos: a ``feed_gap``
+holds the feed silent mid-run, ``drift@40`` rotates every label from
+record 40 on (a real concept shift), and the producer's live connection
+is severed mid-stream (``kill_connections`` — reconnect-and-resume).
+Asserted: both tenants finish; the drift sentinel PAGES and then CLEARS
+(recovery timed); the source reconnected; exactly-once on the in-process
+commit log AND the offset journal; the served model answers the
+*drifted* world (post-drift weights actually reached serving); the
+event-to-served-weight freshness was measured; and the telemetry
+report's Streaming section carries all of it.
+
+**Leg 2 (SIGKILL durability):** a single-worker streaming trainer runs
+as a child process against a durable ``python -m distkeras_tpu.netps``
+subprocess (state dir + fold journal). The child's fault plan SIGKILLs
+it mid-stream (``kill@8`` — no cleanup, no atexit). The restarted child
+resumes from the offset journal + newest intact checkpoint and must
+re-deliver ZERO offsets the journal already held as committed, finish
+the stream, and leave a PS journal holding exactly one fold per record
+— exactly-once proven against the only evidence a SIGKILL leaves: the
+two on-disk journals.
+
+    python tests/smoke_streaming_chaos.py
+"""
+
+import os
+import sys
+
+# Runs from a checkout without installation: sys.path[0] is tests/, so the
+# repo root must be appended (an installed distkeras_tpu still wins).
+sys.path.append(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from distkeras_tpu.models import Model  # noqa: E402
+from distkeras_tpu.models.mlp import MLP  # noqa: E402
+from distkeras_tpu.ops.losses import get_loss  # noqa: E402
+from distkeras_tpu.ops.optimizers import get_optimizer  # noqa: E402
+from distkeras_tpu.streaming import (  # noqa: E402
+    DriftWatch,
+    FileTailSource,
+    OffsetJournal,
+    SocketSource,
+    StreamingTraining,
+    StreamProducer,
+    WindowedEval,
+    replayed_offsets,
+)
+
+#: leg-1 stream schedule: 40 in-distribution records, then the injected
+#: shift rotates every label from record DRIFT_AT on. Pinned, not random.
+TOTAL_1 = 120
+DRIFT_AT = 40
+FAULTS_1 = "feed_gap@12:0.4;drift@%d;seed=3" % DRIFT_AT
+
+#: leg-2: the child is SIGKILLed claiming record KILL_AT of TOTAL_2.
+TOTAL_2 = 20
+KILL_AT = 8
+CLASSES = 3
+
+
+def _build_model(seed=0):
+    return Model.build(MLP(hidden=(16,), num_outputs=CLASSES),
+                       jnp.zeros((1, 4), jnp.float32), seed=seed)
+
+
+def _blob_batch(rng, centers, k, b):
+    y = rng.integers(0, CLASSES, size=(k, b))
+    x = (centers[y] + rng.normal(scale=0.5, size=(k, b, 4))).astype(
+        np.float32)
+    return x, y.astype(np.int32)
+
+
+def _ce_loss(logits, y):
+    logits = np.asarray(logits, np.float64)
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    return float(-logp[np.arange(len(y)), y].mean())
+
+
+# ---------------------------------------------------------------------------
+# Leg 1: fleet-colocated streaming tenant + serving loop under chaos
+# ---------------------------------------------------------------------------
+
+def leg_fleet(base_dir) -> dict:
+    import threading
+    import time
+
+    from distkeras_tpu import DataFrame, checkpoint as ckpt_mod, telemetry
+    from distkeras_tpu.data.batching import make_batches
+    from distkeras_tpu.fleet import (
+        DONE,
+        ElasticTraining,
+        FleetJob,
+        FleetScheduler,
+    )
+    from distkeras_tpu.resilience import faults
+    from distkeras_tpu.resilience.faults import FaultPlan
+    from distkeras_tpu.serving import ModelRegistry
+    from distkeras_tpu.telemetry.report import build_report
+
+    ckpt_dir = os.path.join(base_dir, "leg1-ckpt")
+    journal_path = os.path.join(base_dir, "leg1-offsets.json")
+    rng = np.random.default_rng(7)
+    centers = rng.normal(scale=4.0, size=(CLASSES, 4))
+
+    # Held-out eval set in the DRIFTED world: the regression gate scores
+    # every hot-swap candidate on it, and the final serving check demands
+    # the live model answers it — i.e. post-drift weights reached serving.
+    xh, yh = _blob_batch(rng, centers, 1, 64)
+    xh, yh = xh[0], yh[0]
+    yh_drift = ((yh + 1) % CLASSES).astype(np.int32)
+
+    faults.set_plan(FaultPlan.parse(FAULTS_1))
+    prod = StreamProducer()
+
+    watch = DriftWatch(window=WindowedEval(fast=8, slow=40))
+    rt_stream = StreamingTraining(
+        model=_build_model(seed=0), tx=get_optimizer("sgd", 0.1),
+        loss_fn=get_loss("sparse_categorical_crossentropy"),
+        source=SocketSource(prod.endpoint, drift_classes=CLASSES),
+        num_workers=2, discipline="adag", seed=0,
+        journal=journal_path, checkpoint_dir=ckpt_dir, checkpoint_every=10,
+        drift_watch=watch, max_pending=8)
+
+    def produce():
+        # Trickle, throttled against training progress: event timestamps
+        # track wall time (so freshness-at-swap means something) and the
+        # feed is still live mid-run when the connection is severed.
+        prng = np.random.default_rng(11)
+        t0 = time.monotonic()
+        for i in range(TOTAL_1):
+            while (i - rt_stream.progress() > 24
+                   and time.monotonic() - t0 < 300):
+                time.sleep(0.02)
+            xs, ys = _blob_batch(prng, centers, 2, 16)
+            prod.feed(xs, ys)
+        prod.end()
+
+    threading.Thread(target=produce, daemon=True).start()
+
+    # The colocated batch tenant: same pool, ordinary finite claim queue.
+    df = DataFrame({"features": (centers[rng.integers(0, CLASSES, 256)]
+                                 + rng.normal(scale=0.5, size=(256, 4))
+                                 ).astype(np.float32),
+                    "label": rng.integers(0, CLASSES, 256).astype(np.int32)})
+    df = DataFrame({"features": df["features"], "label": df["label"]})
+    plan = make_batches(df, "features", "label", batch_size=16,
+                        num_workers=2, window=4, num_epoch=1, shuffle=True,
+                        seed=5)
+    rt_batch = ElasticTraining(
+        model=_build_model(seed=1), tx=get_optimizer("sgd", 0.1),
+        loss_fn=get_loss("sparse_categorical_crossentropy"),
+        plan=plan, discipline="adag", seed=1)
+
+    serve_model = _build_model(seed=0)
+    gate = watch.regression_gate(
+        lambda cand: _ce_loss(cand.infer((xh,)), yh_drift),
+        regress_floor=0.5)
+    registry = ModelRegistry(serve_model, (64,), directory=ckpt_dir,
+                             poll_s=0.15, quality_gate=gate)
+    registry.start()
+
+    sched = FleetScheduler(capacity=3, tick_s=0.02)
+    job_s = sched.submit(FleetJob("stream", "acme", rt_stream,
+                                  priority=0, min_gang=1, max_workers=2))
+    job_b = sched.submit(FleetJob("batch", "bidco", rt_batch,
+                                  priority=0, min_gang=1, max_workers=2))
+    sched.start()
+    try:
+        # Mid-stream source kill, after training is demonstrably flowing
+        # and before the drift record lands.
+        deadline = time.monotonic() + 240
+        while rt_stream.progress() < 20:
+            assert time.monotonic() < deadline, "streaming warmup stalled"
+            time.sleep(0.05)
+        prod.kill_connections()
+        assert sched.wait(timeout=420), (
+            f"fleet did not finish: {sched.stats()}")
+    finally:
+        sched.close()
+        registry.close()
+        prod.close()
+        faults.reset()
+
+    for job in (job_s, job_b):
+        assert job.state == DONE, f"{job.job_id} ended {job.state}"
+    assert not rt_stream.errors, rt_stream.errors[:3]
+
+    # The chaos bit: gap + drift injected, connection survived severing.
+    reg = telemetry.get()
+    events = reg.events()
+    kinds = {e["kind"] for e in events}
+    fired = {e.get("fault") for e in events if e["kind"] == "fault_injected"}
+    assert "feed_gap" in fired, "the feed-gap drill never fired"
+    assert "drift" in fired, "the drift drill never fired"
+    assert reg.counter("stream.source_reconnects").value >= 1, (
+        "the severed feed connection never reconnected")
+
+    # Drift sentinel paged, checkpoint-on-drift anchored, then CLEARED
+    # with a measured recovery time (the model relearned the rotation).
+    assert "stream_drift_detected" in kinds, "drift never paged"
+    assert "stream_drift_recovered" in kinds, "the drift page never cleared"
+    assert watch.last_recovery_s is not None and watch.last_recovery_s > 0
+    assert not watch.paging, "still paging after the stream drained"
+
+    # Exactly-once, both ledgers: every record folded into the PS center
+    # exactly once, and the journal's committed set is the full stream.
+    pairs = [(w, s) for w, s, _ in rt_stream.server.commit_log]
+    assert len(pairs) == TOTAL_1, (
+        f"{len(pairs)} folds for {TOTAL_1} records")
+    assert len(set(pairs)) == len(pairs), "a (wid, seq) folded twice"
+    journal = OffsetJournal(journal_path)
+    assert journal.load(), "offset journal unreadable after the run"
+    assert journal.committed_offsets_upto(TOTAL_1) == set(range(TOTAL_1))
+
+    # The loop actually closed: the registry swapped a post-drift
+    # checkpoint in (through the regression gate) and the live model
+    # answers the drifted world.
+    registry.poll_once()  # pick up the final checkpoint
+    bm, version = registry.current()
+    assert version > -1, "no checkpoint ever reached serving"
+    meta = ckpt_mod.read_meta(ckpt_dir, version) or {}
+    assert meta.get("items", 0) > DRIFT_AT, (
+        f"served step {version} predates the drift: {meta}")
+    assert meta.get("event_ts") is not None, "meta lost the event anchor"
+    acc = float((np.asarray(bm.infer((xh,))).argmax(-1)
+                 == yh_drift).mean())
+    assert acc > 0.8, f"served model never adapted to the drift: {acc}"
+
+    # Freshness was measured at swap, and the report attributes the run.
+    jsonl = os.path.join(base_dir, "leg1-run.jsonl")
+    telemetry.write_jsonl(reg, jsonl)
+    strm = build_report(jsonl).get("streaming")
+    assert strm, "report has no Streaming section"
+    assert strm.get("items_committed", 0) >= TOTAL_1, strm
+    assert strm.get("drift_events", 0) >= 1, strm
+    assert strm.get("source_reconnects", 0) >= 1, strm
+    assert strm.get("freshness_count", 0) >= 1, (
+        f"no freshness measurement reached the report: {strm}")
+    assert "recovery_s" in strm, strm
+    assert "candidate_loss" in strm, "the quality gate never scored"
+    fresh = strm.get("freshness_max_s")
+    assert fresh is not None and fresh < 60.0, (
+        f"event-to-served-weight freshness implausible: {fresh}")
+    return {"acc": acc, "version": version, "recovery_s":
+            round(watch.last_recovery_s, 3),
+            "freshness_max_s": fresh}
+
+
+# ---------------------------------------------------------------------------
+# Leg 2: SIGKILL the trainer; resume must replay nothing, lose nothing
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _write_stream(path):
+    from distkeras_tpu.streaming import StreamFileWriter
+
+    rng = np.random.default_rng(21)
+    centers = rng.normal(scale=4.0, size=(CLASSES, 4))
+    w = StreamFileWriter(path)
+    for _ in range(TOTAL_2):
+        xs, ys = _blob_batch(rng, centers, 1, 8)
+        w.append(xs, ys)
+    w.end()
+
+
+def child_main() -> int:
+    """One streaming trainer attempt against the durable external PS —
+    run twice by the parent: attempt 1 dies to ``kill@8`` (journaled to
+    DKTPU_FAULTS_STATE so the restart is not re-poisoned), attempt 2
+    resumes and drains. Prints the delivered offsets so the parent can
+    assert the zero-replay set."""
+    base = os.environ["STREAM_SMOKE_DIR"]
+    src = FileTailSource(os.path.join(base, "stream.bin"), poll_s=0.02,
+                         drift_classes=CLASSES)
+    delivered = []
+
+    class Recorder:
+        drift_from = None
+
+        def read(self, start_index, skip):
+            for rec in src.read(start_index, skip):
+                delivered.append(rec.index)
+                yield rec
+
+        def close(self):
+            src.close()
+
+    rt = StreamingTraining(
+        model=_build_model(seed=0), tx=get_optimizer("sgd", 0.1),
+        loss_fn=get_loss("sparse_categorical_crossentropy"),
+        source=Recorder(), num_workers=1, discipline="adag", seed=0,
+        endpoint=os.environ["STREAM_SMOKE_ENDPOINT"],
+        journal=os.path.join(base, "offsets.json"),
+        checkpoint_dir=os.path.join(base, "ckpt"), checkpoint_every=5,
+        resume=True)
+    rt.ensure_started()
+    rt.worker_main(0, lambda: True)
+    rt.close()
+    if rt.errors:
+        raise rt.errors[0]
+    print("STREAM_CHILD_DELIVERED " + ",".join(map(str, delivered)))
+    print("STREAM_CHILD_OK committed=%d" % rt.journal.items_committed)
+    return 0
+
+
+def leg_sigkill(base_dir) -> dict:
+    import signal
+    import subprocess
+
+    from distkeras_tpu.netps import state as netps_state
+
+    state_dir = os.path.join(base_dir, "leg2-ps-state")
+    work_dir = os.path.join(base_dir, "leg2")
+    os.makedirs(state_dir, exist_ok=True)
+    os.makedirs(work_dir, exist_ok=True)
+    _write_stream(os.path.join(work_dir, "stream.bin"))
+
+    # The durable PS: a real subprocess with a state dir + fold journal —
+    # the only exactly-once evidence that survives the trainer's SIGKILL.
+    port = _free_port()
+    drop = {"DKTPU_FAULTS", "DKTPU_FAULTS_STATE"}
+    ps_env = {k: v for k, v in os.environ.items() if k not in drop}
+    ps_env["JAX_PLATFORMS"] = "cpu"
+    ps = subprocess.Popen(
+        [sys.executable, "-m", "distkeras_tpu.netps", "--host", "127.0.0.1",
+         "--port", str(port), "--discipline", "adag",
+         # No compaction: the journal must retain EVERY fold of the run,
+         # it is the exactly-once evidence this leg exists to check.
+         "--state-dir", state_dir, "--snapshot-every", "100000"],
+        env=ps_env, stdout=subprocess.PIPE, text=True)
+    endpoint = None
+    for line in ps.stdout:
+        if line.startswith("NETPS_READY"):
+            endpoint = line.split()[1]
+            break
+    assert endpoint, "netps subprocess never came up"
+
+    child_env = dict(os.environ)
+    child_env.update({
+        "JAX_PLATFORMS": "cpu",
+        "STREAM_SMOKE_ROLE": "child",
+        "STREAM_SMOKE_DIR": work_dir,
+        "STREAM_SMOKE_ENDPOINT": endpoint,
+        "DKTPU_FAULTS": f"kill@{KILL_AT}",
+        "DKTPU_FAULTS_STATE": os.path.join(work_dir, "faults.state"),
+    })
+    me = os.path.abspath(__file__)
+    try:
+        # Attempt 1: dies to the unmaskable mid-stream kill.
+        r1 = subprocess.run([sys.executable, me], env=child_env,
+                            capture_output=True, text=True, timeout=240)
+        assert r1.returncode == -signal.SIGKILL, (
+            f"attempt 1 should die to SIGKILL, got {r1.returncode}:\n"
+            f"{r1.stdout}\n{r1.stderr}")
+
+        # What the journal provably held at the moment of death.
+        journal = OffsetJournal(os.path.join(work_dir, "offsets.json"))
+        assert journal.load(), "no journal survived the SIGKILL"
+        before = journal.committed_offsets_upto(TOTAL_2)
+        assert before == set(range(KILL_AT)), (
+            f"journal at death should hold 0..{KILL_AT - 1}: {before}")
+
+        # Attempt 2: resume. Must drain the stream without re-delivering
+        # a single already-committed offset.
+        r2 = subprocess.run([sys.executable, me], env=child_env,
+                            capture_output=True, text=True, timeout=240)
+        assert r2.returncode == 0, (
+            f"resumed attempt failed rc={r2.returncode}:\n"
+            f"{r2.stdout}\n{r2.stderr}")
+        delivered2 = []
+        for line in r2.stdout.splitlines():
+            if line.startswith("STREAM_CHILD_DELIVERED"):
+                body = line.split(" ", 1)[1] if " " in line else ""
+                delivered2 = [int(t) for t in body.split(",") if t]
+        replay = replayed_offsets(before, delivered2)
+        assert replay == set(), (
+            f"resume replayed committed offsets: {sorted(replay)}")
+        assert f"committed={TOTAL_2}" in r2.stdout, r2.stdout
+
+        # Zero lost: the journal now holds the whole stream...
+        journal = OffsetJournal(os.path.join(work_dir, "offsets.json"))
+        assert journal.load()
+        after = journal.committed_offsets_upto(TOTAL_2)
+        assert after == set(range(TOTAL_2)), f"records lost: {after}"
+    finally:
+        ps.terminate()
+        try:
+            ps.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            ps.kill()
+            ps.wait(timeout=10)
+
+    # ...and the PS's on-disk journal shows exactly one fold per record,
+    # across both attempts of the killed-and-resumed worker.
+    records = netps_state.read_journal(state_dir)
+    pairs = [(int(r["wid"]), int(r["seq"])) for r in records]
+    assert len(pairs) == TOTAL_2, (
+        f"{len(pairs)} folds journaled for {TOTAL_2} records")
+    assert len(set(pairs)) == len(pairs), "a (wid, seq) folded twice"
+    return {"delivered_after_resume": len(delivered2),
+            "folds": len(pairs)}
+
+
+def main() -> int:
+    import shutil
+
+    base_dir = os.environ.get("DKTPU_STREAM_SMOKE_DIR",
+                              "/tmp/dktpu-stream-smoke")
+    shutil.rmtree(base_dir, ignore_errors=True)
+    os.makedirs(base_dir, exist_ok=True)
+
+    r1 = leg_fleet(base_dir)
+    r2 = leg_sigkill(base_dir)
+    print("streaming chaos run: "
+          f"served_acc={r1['acc']:.4f} served_step={r1['version']}"
+          f" recovery_s={r1['recovery_s']}"
+          f" freshness_max_s={r1['freshness_max_s']}"
+          f" resume_delivered={r2['delivered_after_resume']}"
+          f" ps_folds={r2['folds']}")
+    return 0
+
+
+if __name__ == "__main__":
+    if os.environ.get("STREAM_SMOKE_ROLE") == "child":
+        raise SystemExit(child_main())
+    raise SystemExit(main())

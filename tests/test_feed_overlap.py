@@ -44,7 +44,7 @@ def test_round_feeder_overlaps_staging_with_consumption():
     assert len(feeder.waits) == rounds
     # Past the first round the feeder's lookahead has the next batch staged
     # before the consumer asks for it.
-    assert sum(feeder.waits[1:]) < rounds * stage_s * 0.5, feeder.waits
+    assert sum(list(feeder.waits)[1:]) < rounds * stage_s * 0.5, feeder.waits
 
 
 def test_round_feeder_reports_stall_when_staging_dominates():
@@ -54,7 +54,27 @@ def test_round_feeder_reports_stall_when_staging_dominates():
     for r, _ in feeder:
         time.sleep(0.01)
     # Consumer blocked roughly (stage - consume) per round after warmup.
-    assert sum(feeder.waits[1:]) > 0.03, feeder.waits
+    assert sum(list(feeder.waits)[1:]) > 0.03, feeder.waits
+
+
+def test_round_feeder_waits_are_bounded_but_sum_is_not():
+    """An open-ended stream must not grow ``waits`` without bound: the
+    per-round record is a deque capped at WAITS_KEEP, while the running
+    ``wait_seconds`` total keeps counting evicted entries."""
+    from distkeras_tpu.data import prefetch
+
+    old_keep = prefetch.WAITS_KEEP
+    prefetch.WAITS_KEEP = 8
+    try:
+        feeder = prefetch.RoundFeeder(50, lambda r: r)
+        total = 0.0
+        for r, _ in feeder:
+            pass
+        assert len(feeder.waits) == 8  # capped, not 50
+        total = feeder.wait_seconds
+        assert total >= sum(feeder.waits)  # the sum survived eviction
+    finally:
+        prefetch.WAITS_KEEP = old_keep
 
 
 def test_engine_exposes_feed_wait_metric():

@@ -1,0 +1,573 @@
+"""Streaming continual training: exactly-once ingest, drift handling,
+and the claim-queue generalization (PR 17).
+
+Layers under test, smallest to largest: the offset journal's two-phase
+exactly-once protocol; the stream sources (file tail + socket, with
+resume and chaos); the new `feed_gap`/`drift` fault kinds; the shared
+WorkQueue (bounded ElasticTraining parity + open streaming mode); the
+engines' epoch-free `run_stream` loop; DriftWatch paging/recovery and
+the rollback-on-regression gate; the registry's freshness-at-swap; and
+StreamingTraining end-to-end — including Supervisor retry-with-resume
+interplay, where the crash-restart run must replay ZERO committed
+offsets (cross-checked against the PS commit log)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.resilience import faults
+from distkeras_tpu.streaming import (
+    DriftWatch, FileTailSource, OffsetJournal, SocketSource, StreamProducer,
+    StreamFileWriter, StreamingSession, StreamingTraining, WindowedEval,
+    WorkQueue, decode_record, encode_record)
+from distkeras_tpu.streaming.journal import replayed_offsets
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ambient():
+    telemetry.reset()
+    faults.reset()
+    yield
+    faults.reset()
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# Offset journal
+# ---------------------------------------------------------------------------
+
+def test_journal_roundtrip_frontier_and_ahead(tmp_path):
+    path = str(tmp_path / "offsets.json")
+    j = OffsetJournal(path)
+    j.intent(0, 1, 0)
+    j.committed(0, 0, event_ts=100.0)
+    # Out-of-order commit parks in `ahead`, absorbed when the gap closes.
+    j.committed(1, 2, event_ts=102.0)
+    assert j.frontier == 1 and j.skip_offsets() == frozenset({2})
+    j.committed(0, 1, event_ts=101.0)
+    assert j.frontier == 3 and j.skip_offsets() == frozenset()
+    j.set_meta(drift_from=7)
+
+    j2 = OffsetJournal(path)
+    assert j2.load() is True
+    assert j2.frontier == 3
+    assert j2.items_committed == 3
+    assert j2.last_event_ts == 102.0
+    assert j2.meta == {"drift_from": 7}
+    assert j2.committed_offsets_upto(10) == {0, 1, 2}
+    assert os.path.exists(path + ".sha256")
+
+
+def test_journal_corruption_falls_back_to_previous_generation(tmp_path):
+    path = str(tmp_path / "offsets.json")
+    j = OffsetJournal(path)
+    j.committed(0, 0)
+    j.committed(0, 1)  # generation 2; generation 1 (frontier=1) is .prev
+    with open(path, "ab") as f:
+        f.write(b"torn")
+    j2 = OffsetJournal(path)
+    assert j2.load() is True, "must fall back to .prev, not to zero"
+    assert j2.frontier == 1, "the previous generation's frontier"
+
+
+def test_journal_resolve_landed_vs_unlanded_intents(tmp_path):
+    j = OffsetJournal(str(tmp_path / "offsets.json"))
+    j.committed(0, 0)
+    j.intent(0, 5, 1)   # will have landed (PS folded seq 5, ACK lost)
+    j.intent(1, 9, 2)   # never reached the PS
+    landed = j.resolve({0: 5, 1: 8})
+    assert landed == [1], "seq<=last_seq means the fold landed"
+    assert j.frontier == 2, "landed offset is committed, never re-read"
+    assert j.skip_offsets() == frozenset()
+    assert j.start_offset() == 2, "offset 2 will be re-read and re-sent"
+    # Both intents are gone either way.
+    j2 = OffsetJournal(j.path)
+    assert j2.load() and j2._intents == {}
+
+
+def test_replayed_offsets_helper():
+    assert replayed_offsets({0, 1, 2}, [3, 4]) == set()
+    assert replayed_offsets({0, 1, 2}, [2, 3]) == {2}
+
+
+# ---------------------------------------------------------------------------
+# Sources
+# ---------------------------------------------------------------------------
+
+def _feed_arrays(i, k=1, b=4, feat=3):
+    xs = np.full((k, b, feat), float(i), np.float32)
+    ys = np.full((k, b), i % 3, np.int32)
+    return xs, ys
+
+
+def test_record_codec_roundtrip():
+    xs, ys = _feed_arrays(7)
+    frame = encode_record(xs, ys, 123.5)
+    rec = decode_record(frame[4:], index=7)
+    assert rec.index == 7 and rec.ts == 123.5
+    np.testing.assert_array_equal(rec.xs, xs)
+    np.testing.assert_array_equal(rec.ys, ys)
+
+
+def test_file_tail_source_reads_resumes_and_skips(tmp_path):
+    path = str(tmp_path / "feed.bin")
+    w = StreamFileWriter(path)
+    for i in range(6):
+        w.append(*_feed_arrays(i), ts=float(i))
+    w.end()
+
+    src = FileTailSource(path, poll_s=0.01)
+    got = list(src.read())
+    assert [r.index for r in got] == list(range(6))
+    assert all(float(r.xs[0, 0, 0]) == r.index for r in got)
+
+    # Resume: start at the frontier, skip the out-of-order-committed set.
+    src2 = FileTailSource(path, poll_s=0.01)
+    got2 = [r.index for r in src2.read(start_index=2, skip=frozenset({4}))]
+    assert got2 == [2, 3, 5]
+
+
+def test_socket_source_survives_connection_kill():
+    prod = StreamProducer()
+    try:
+        for i in range(10):
+            prod.feed(*_feed_arrays(i))
+        src = SocketSource(prod.endpoint, reconnect_s=5.0)
+        seen = []
+        it = src.read()
+        for _ in range(4):
+            seen.append(next(it).index)
+        prod.kill_connections()  # the source-kill drill, mid-stream
+        prod.end()
+        seen.extend(r.index for r in it)
+        assert seen == list(range(10)), "retransmits only: no loss, no dup"
+        assert src.reconnects >= 1
+    finally:
+        prod.close()
+
+
+# ---------------------------------------------------------------------------
+# feed_gap / drift fault kinds
+# ---------------------------------------------------------------------------
+
+def test_feed_gap_and_drift_parse_and_one_shot():
+    plan = faults.FaultPlan.parse("feed_gap@3:0.25;drift@5")
+    assert plan.feed_gap(2) == 0.0
+    assert plan.feed_gap(3) == 0.25
+    assert plan.feed_gap(3) == 0.0, "one-shot"
+    assert plan.drift(4) is False
+    assert plan.drift(5) is True
+    assert plan.drift(5) is False, "one-shot"
+
+
+def test_drift_fault_shifts_labels_permanently(tmp_path):
+    path = str(tmp_path / "feed.bin")
+    w = StreamFileWriter(path)
+    for i in range(6):
+        xs = np.zeros((1, 4, 2), np.float32)
+        ys = np.full((1, 4), 1, np.int32)
+        w.append(xs, ys)
+    w.end()
+    faults.set_plan(faults.FaultPlan.parse("drift@3"))
+    src = FileTailSource(path, poll_s=0.01, drift_classes=3)
+    got = list(src.read())
+    for r in got:
+        if r.index < 3:
+            assert not r.drifted and int(r.ys[0, 0]) == 1
+        else:
+            # (1 + 1) % 3 — the shift persists past the one-shot trigger.
+            assert r.drifted and int(r.ys[0, 0]) == 2
+    assert src.drift_from == 3
+
+
+def test_feed_gap_fault_delays_delivery(tmp_path):
+    path = str(tmp_path / "feed.bin")
+    w = StreamFileWriter(path)
+    for i in range(3):
+        w.append(*_feed_arrays(i))
+    w.end()
+    faults.set_plan(faults.FaultPlan.parse("feed_gap@1:0.3"))
+    src = FileTailSource(path, poll_s=0.01)
+    t0 = time.perf_counter()
+    assert [r.index for r in src.read()] == [0, 1, 2]
+    assert time.perf_counter() - t0 >= 0.3, "record 1 was held back"
+
+
+# ---------------------------------------------------------------------------
+# WorkQueue (shared claim discipline)
+# ---------------------------------------------------------------------------
+
+def test_work_queue_bounded_mode_matches_elastic_semantics():
+    q = WorkQueue(total=4)
+    run = lambda: True
+    assert q.claim(run) == 0 and q.claim(run) == 1
+    q.requeue(0)
+    assert q.claim(run) == 0, "retry queue wins over the frontier"
+    for _ in range(2):
+        q.commit_one()
+    assert not q.done()
+    assert q.claim(run) == 2 and q.claim(run) == 3
+    q.commit_one()
+    q.commit_one()
+    assert q.done()
+    assert q.claim(run) is None
+
+
+def test_work_queue_bounded_claim_blocks_while_peers_in_flight():
+    q = WorkQueue(total=2)
+    a = q.claim(lambda: True)
+    b = q.claim(lambda: True)
+    got = []
+
+    def late_claim():
+        got.append(q.claim(lambda: True))
+
+    t = threading.Thread(target=late_claim)
+    t.start()
+    time.sleep(0.05)
+    q.requeue(a)  # eviction path: the requeued item must find the claimant
+    t.join(timeout=5.0)
+    assert got == [a]
+    q.commit_one()
+    q.commit_one()
+    assert q.done()
+
+
+def test_work_queue_open_mode_backpressure_and_done():
+    q = WorkQueue(max_pending=2)
+    assert q.put("a") and q.put("b")
+    blocked = []
+
+    def put_c():
+        blocked.append(q.put("c"))
+
+    t = threading.Thread(target=put_c)
+    t.start()
+    time.sleep(0.05)
+    assert not blocked, "put blocks at max_pending (backpressure)"
+    item = q.claim(lambda: True)
+    t.join(timeout=5.0)
+    assert blocked == [True] and item == "a"
+    assert not q.done()
+    q.commit_one()
+    q.close_intake()
+    assert not q.done(), "pending items remain"
+    assert q.claim(lambda: True) == "b"
+    q.commit_one()
+    assert q.claim(lambda: True) == "c"
+    q.commit_one()
+    assert q.done()
+    assert q.claim(lambda: True) is None
+    assert q.put("d") is False, "intake closed"
+
+
+# ---------------------------------------------------------------------------
+# RoundFeeder items mode + engine run_stream
+# ---------------------------------------------------------------------------
+
+def test_round_feeder_accepts_item_iterables():
+    from distkeras_tpu.data.prefetch import RoundFeeder
+
+    items = ["a", "b", "c"]
+    feeder = RoundFeeder(iter(items), stage=str.upper, start_round=5)
+    assert list(feeder) == [(5, "A"), (6, "B"), (7, "C")]
+
+
+def test_run_stream_trains_sync_engine_without_epoch_schedule():
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.parallel.sync import SyncEngine
+    from distkeras_tpu.runtime.mesh import data_mesh
+
+    rng = np.random.default_rng(0)
+    model = Model.build(MLP(hidden=(8,), num_outputs=3),
+                        jnp.zeros((1, 4), jnp.float32))
+    engine = SyncEngine(model, "sgd", "sparse_categorical_crossentropy",
+                        data_mesh(num_workers=2), learning_rate=0.05)
+
+    def batches():
+        while True:  # endless — max_items must bound it
+            xs = rng.normal(size=(2, 2, 8, 4)).astype(np.float32)
+            ys = rng.integers(0, 3, size=(2, 2, 8)).astype(np.int32)
+            yield xs, ys
+
+    seen = []
+    state, losses = engine.run_stream(
+        batches(), on_item=lambda i, loss, st: seen.append(i),
+        max_items=6)
+    assert losses.size == 6, "one loss per consumed item"
+    assert np.all(np.isfinite(losses))
+    assert seen == list(range(6))
+    assert engine.feed_wait_seconds >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# Windowed eval, drift watch, regression gate
+# ---------------------------------------------------------------------------
+
+def test_drift_watch_pages_then_clears_with_recovery_time():
+    watch = DriftWatch(window=WindowedEval(fast=4, slow=16),
+                       drift_factor=2.0, floor=0.05)
+    drifts, recoveries = [], []
+    watch.on_drift = lambda fast, slow: drifts.append((fast, slow))
+    watch.on_recover = lambda s: recoveries.append(s)
+    for _ in range(16):
+        assert watch.update(0.1) is None, "healthy baseline never pages"
+    fired = [watch.update(10.0) for _ in range(4)]
+    assert "fired" in fired
+    assert watch.paging and watch.drift_events == 1 and len(drifts) == 1
+    cleared = [watch.update(0.1) for _ in range(16)]
+    assert "cleared" in cleared
+    assert not watch.paging
+    assert recoveries and watch.last_recovery_s is not None
+    snap = telemetry.get().snapshot()
+    assert snap["counters"]["stream.drift_events"] == 1
+    assert "stream.recovery_seconds" in snap["gauges"]
+
+
+def test_drift_watch_warmup_never_pages():
+    watch = DriftWatch(window=WindowedEval(fast=8, slow=64),
+                       drift_factor=2.0, floor=0.05)
+    # Huge losses during warmup: both windows track each other — no page.
+    for _ in range(8):
+        assert watch.update(50.0) is None
+    assert not watch.paging
+
+
+def test_regression_gate_refuses_regressed_candidate():
+    watch = DriftWatch(window=WindowedEval(fast=4, slow=8))
+    losses = {"good": 1.0, "better": 0.8, "regressed": 1.5}
+    gate = watch.regression_gate(lambda name: losses[name],
+                                 regress_floor=0.25)
+    assert gate("good", 1) is True
+    assert gate("better", 2) is True
+    assert gate("regressed", 3) is False, "1.5 > 0.8 * 1.25"
+    assert gate("good", 4) is True, "1.0 <= 0.8 * 1.25"
+    events = [e["kind"] for e in telemetry.get().events()]
+    assert "stream_swap_rolled_back" in events
+
+
+def test_registry_quality_gate_and_freshness(tmp_path):
+    import jax
+
+    from distkeras_tpu.checkpoint import Checkpointer
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.mlp import MLP
+    from distkeras_tpu.serving.registry import ModelRegistry
+
+    model = Model.build(MLP(hidden=(4,), num_outputs=3),
+                        jnp.zeros((1, 4), jnp.float32))
+    directory = str(tmp_path)
+
+    def save(step, event_age_s):
+        ckpt = Checkpointer(directory)
+        params = jax.tree.map(lambda a: np.asarray(a), model.params)
+        assert ckpt.save(step, params, wait=True,
+                         meta={"streaming": True,
+                               "event_ts": time.time() - event_age_s})
+        ckpt.close()
+
+    verdicts = iter([True, False])
+    registry = ModelRegistry(model, (1, 4), directory=directory,
+                             poll_s=30.0,
+                             quality_gate=lambda cand, step: next(verdicts))
+    try:
+        save(1, event_age_s=5.0)
+        assert registry.poll_once() is True and registry.version == 1
+        snap = telemetry.get().snapshot()
+        # Freshness at swap: now - the newest folded event's timestamp.
+        assert snap["gauges"]["serving.freshness_s"]["value"] >= 4.0
+        assert snap["spans"]["serving.freshness"]["count"] == 1
+
+        save(2, event_age_s=0.0)
+        assert registry.poll_once() is False, "gate refused the candidate"
+        assert registry.version == 1, "incumbent keeps serving"
+        snap = telemetry.get().snapshot()
+        assert snap["counters"]["serving.swap_rejected_regression"] == 1
+        assert registry.poll_once() is False, "refusal is remembered"
+    finally:
+        registry.close()
+
+
+# ---------------------------------------------------------------------------
+# StreamingTraining end to end
+# ---------------------------------------------------------------------------
+
+def _build_model(seed=0):
+    from distkeras_tpu.models.base import Model
+    from distkeras_tpu.models.mlp import MLP
+
+    return Model.build(MLP(hidden=(16,), num_outputs=3),
+                       jnp.zeros((1, 4), jnp.float32), seed=seed)
+
+
+def _stream_file(tmp_path, n, seed=0, k=2, b=8):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=4.0, size=(3, 4))
+    path = str(tmp_path / "feed.bin")
+    w = StreamFileWriter(path)
+    for i in range(n):
+        y = rng.integers(0, 3, size=(k, b))
+        x = (centers[y] + rng.normal(scale=0.5, size=(k, b, 4))).astype(
+            np.float32)
+        w.append(x, y.astype(np.int32), ts=float(i))
+    w.end()
+    return path
+
+
+def _make_runtime(tmp_path, path, **kw):
+    from distkeras_tpu.ops.losses import get_loss
+    from distkeras_tpu.ops.optimizers import get_optimizer
+
+    kw.setdefault("journal", str(tmp_path / "offsets.json"))
+    kw.setdefault("checkpoint_dir", str(tmp_path / "ckpt"))
+    kw.setdefault("checkpoint_every", 4)
+    return StreamingTraining(
+        model=_build_model(), tx=get_optimizer("sgd", 0.1),
+        loss_fn=get_loss("sparse_categorical_crossentropy"),
+        source=FileTailSource(path, poll_s=0.01, drift_classes=3), **kw)
+
+
+def test_streaming_training_exactly_once_in_process(tmp_path):
+    n = 12
+    path = _stream_file(tmp_path, n)
+    rt = _make_runtime(tmp_path, path, num_workers=2)
+    sess = StreamingSession(lambda resume: rt, num_workers=2,
+                            checkpoint_dir=rt.checkpoint_dir,
+                            checkpoint_every=rt.checkpoint_every)
+    model = sess.train()
+    assert model is not None
+    assert rt.progress() == n
+    assert rt.done()
+
+    # Exactly-once against the PS commit log: one applied fold per record,
+    # no (wid, seq) ever folded twice.
+    log = rt.server.commit_log
+    assert len(log) == n
+    assert len({(wid, seq) for wid, seq, _ in log}) == n
+
+    # The journal agrees, and agrees durably (reload from disk).
+    j = OffsetJournal(str(tmp_path / "offsets.json"))
+    assert j.load() is True
+    assert j.frontier == n and j.items_committed == n
+    assert j.committed_offsets_upto(n) == set(range(n))
+
+    # Checkpoints landed with the freshness anchor in their meta.
+    from distkeras_tpu import checkpoint as ckpt_mod
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    steps = ckpt_mod.scan_steps(ckpt_dir)
+    assert steps, "interval checkpoints must exist"
+    meta = ckpt_mod.read_meta(ckpt_dir, steps[0])
+    assert meta["streaming"] is True and meta["event_ts"] is not None
+
+
+class _RecordingSource:
+    """Wrap a source, logging every delivered index — the replay probe."""
+
+    def __init__(self, inner, log):
+        self._inner = inner
+        self.log = log
+
+    @property
+    def drift_from(self):
+        return self._inner.drift_from
+
+    @drift_from.setter
+    def drift_from(self, v):
+        self._inner.drift_from = v
+
+    def read(self, start_index=0, skip=frozenset()):
+        for rec in self._inner.read(start_index, skip):
+            self.log.append(rec.index)
+            yield rec
+
+    def close(self):
+        self._inner.close()
+
+
+def test_supervisor_resume_replays_zero_committed_items(tmp_path):
+    """The resume-interplay drill: crash mid-stream under the Supervisor,
+    resume from offset journal + checkpoint, and prove the restarted run
+    re-reads NOTHING the journal holds as committed — while the PS commit
+    log shows every record folded exactly once across both attempts."""
+    from distkeras_tpu.netps.server import PSServer
+    from distkeras_tpu.ops.losses import get_loss
+    from distkeras_tpu.ops.optimizers import get_optimizer
+    from distkeras_tpu.resilience.errors import InjectedFault
+    from distkeras_tpu.resilience.supervisor import Supervisor
+
+    n = 10
+    path = _stream_file(tmp_path, n)
+    jpath = str(tmp_path / "offsets.json")
+    ckpt_dir = str(tmp_path / "ckpt")
+    # The PS outlives the crash (the in-process analogue of the durable
+    # netps subprocess the chaos smoke uses).
+    server = PSServer(discipline="adag", host="127.0.0.1", port=0).start()
+    faults.set_plan(faults.FaultPlan.parse("crash@5"))
+    committed_before = {}
+    delivered = {}
+    attempt = [0]
+
+    def factory(resume):
+        attempt[0] += 1
+        if resume:
+            probe = OffsetJournal(jpath)
+            assert probe.load() is True
+            committed_before["set"] = probe.committed_offsets_upto(n)
+        log = []
+        delivered[attempt[0]] = log
+        return StreamingTraining(
+            model=_build_model(), tx=get_optimizer("sgd", 0.1),
+            loss_fn=get_loss("sparse_categorical_crossentropy"),
+            source=_RecordingSource(
+                FileTailSource(path, poll_s=0.01, drift_classes=3), log),
+            num_workers=1, journal=jpath, endpoint=server.endpoint,
+            checkpoint_dir=ckpt_dir, checkpoint_every=2, resume=resume)
+
+    sess = StreamingSession(factory, num_workers=1,
+                            checkpoint_dir=ckpt_dir, checkpoint_every=2)
+    sup = Supervisor(sess, max_retries=2, backoff_s=0.0,
+                     retry_on=(InjectedFault,))
+    try:
+        with pytest.warns(UserWarning, match="supervised train attempt"):
+            model = sup.train(None)
+        assert model is not None
+        assert sup.attempts == 2
+
+        before = committed_before["set"]
+        assert before == set(range(5)), "crash@5 landed after 5 commits"
+        # THE exactly-once claim: zero replayed committed items...
+        assert replayed_offsets(before, delivered[2]) == set()
+        # ...and zero lost items: everything committed exactly once.
+        j = OffsetJournal(jpath)
+        assert j.load() and j.committed_offsets_upto(n) == set(range(n))
+        log = server.commit_log
+        assert len(log) == n, "one applied fold per record, both attempts"
+        assert len({(wid, seq) for wid, seq, _ in log}) == n
+    finally:
+        server.close()
+
+
+def test_streaming_stall_surfaces_as_feeder_error(tmp_path, monkeypatch):
+    """A dried-up feed must become the Supervisor-visible typed error,
+    not a silent hang: the reader runs through RoundFeeder's watchdog."""
+    from distkeras_tpu.resilience.errors import FeederStalledError
+
+    monkeypatch.setenv("DKTPU_FEEDER_TIMEOUT", "0.5")
+    monkeypatch.setenv("DKTPU_FEEDER_WARN", "0.2")
+    path = str(tmp_path / "feed.bin")
+    w = StreamFileWriter(path)
+    w.append(*_feed_arrays(0, k=2, b=8, feat=4))
+    w.close()  # NO end(): the tail waits forever for a frame
+    rt = _make_runtime(tmp_path, path, num_workers=1)
+    sess = StreamingSession(lambda resume: rt, num_workers=1,
+                            checkpoint_dir=rt.checkpoint_dir)
+    with pytest.raises(FeederStalledError):
+        sess.train()
