@@ -38,11 +38,38 @@ def _write_env_docs(repo_root: str) -> int:
     return 0
 
 
+def _write_metric_docs(repo_root: str) -> int:
+    from distkeras_tpu.telemetry import registry
+
+    docs_dir = os.path.join(repo_root, "docs")
+    changed = 0
+    for name in sorted(os.listdir(docs_dir)):
+        if not name.endswith(".md"):
+            continue
+        path = os.path.join(docs_dir, name)
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        try:
+            fresh = registry.splice_metric_docs(text)
+        except ValueError:
+            continue
+        if fresh != text:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(fresh)
+            print(f"dk-check: rewrote metric table(s) in {path}")
+            changed += 1
+    if not changed:
+        print("dk-check: metric docs already in sync")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m distkeras_tpu.analysis",
         description="dk-check: repo-aware static analysis "
-                    "(DK1xx jax purity, DK2xx concurrency, DK3xx config)")
+                    "(DK1xx jax purity, DK2xx concurrency, DK3xx config, "
+                    "DK4xx wire protocol, DK5xx durability, DK6xx "
+                    "contract registries)")
     parser.add_argument("paths", nargs="*", default=None,
                         help="files/directories to check "
                              "(default: the distkeras_tpu package)")
@@ -57,11 +84,17 @@ def main(argv=None) -> int:
     parser.add_argument("--write-env-docs", action="store_true",
                         help="regenerate the env-var tables in docs/*.md "
                              "from runtime.config.ENV_REGISTRY and exit")
+    parser.add_argument("--write-metric-docs", action="store_true",
+                        help="regenerate the metric tables in docs/*.md "
+                             "from telemetry.registry.METRIC_REGISTRY "
+                             "and exit")
     args = parser.parse_args(argv)
 
     pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if args.write_env_docs:
         return _write_env_docs(os.path.dirname(pkg_dir))
+    if args.write_metric_docs:
+        return _write_metric_docs(os.path.dirname(pkg_dir))
     if args.list_rules:
         core._load_rules()
         for rule in sorted(core.RULE_CATALOG):

@@ -6,6 +6,13 @@ broken module still gets checked) with three repo-specific rule families:
 * ``DK1xx`` — JAX purity/retrace hazards (``rules_jax``)
 * ``DK2xx`` — host-thread concurrency hazards (``rules_concurrency``)
 * ``DK3xx`` — environment/config discipline (``rules_config``)
+* ``DK4xx`` — wire-protocol registry discipline (``rules_protocol``)
+* ``DK5xx`` — durability/ordering discipline (``rules_durability``)
+* ``DK6xx`` — contract-registry cross-checks (``rules_contracts``)
+
+plus **DK001**, the meta-rule: a ``# dk: disable=RULE`` suppression whose
+rule can no longer fire on that line is itself a finding — suppressions
+are part of the code under review and must not outlive their reason.
 
 Two rule shapes exist: **module rules** see one parsed file at a time;
 **project rules** see the whole file set (the lock-order graph and the
@@ -59,6 +66,8 @@ class Module:
         self.suppressions: dict = {}
         #: rules suppressed for the whole file
         self.file_suppressions: set = set()
+        #: file-suppressed rule -> line of its disable-file comment (DK001)
+        self.file_suppression_lines: dict = {}
         self._parse_suppressions()
 
     def _parse_suppressions(self) -> None:
@@ -78,6 +87,8 @@ class Module:
                      if r.strip()}
             if m.group("kind") == "disable-file":
                 self.file_suppressions |= rules or {"*"}
+                for r in rules:
+                    self.file_suppression_lines.setdefault(r, line)
             else:
                 self.suppressions.setdefault(line, set()).update(rules or {"*"})
 
@@ -102,6 +113,11 @@ _MODULE_CHECKERS: list = []
 _PROJECT_CHECKERS: list = []
 RULE_CATALOG: dict = {}
 
+# The suppression meta-rule lives in the runner itself (it needs the raw,
+# pre-suppression finding set), so it registers here, not via a decorator.
+RULE_CATALOG["DK001"] = RuleInfo(
+    "DK001", "stale suppression: the rule can no longer fire here")
+
 
 def module_rule(*infos: RuleInfo):
     def deco(fn):
@@ -124,7 +140,8 @@ def project_rule(*infos: RuleInfo):
 def _load_rules() -> None:
     # Import for registration side effects; idempotent.
     from distkeras_tpu.analysis import (  # noqa: F401
-        rules_concurrency, rules_config, rules_jax)
+        rules_concurrency, rules_config, rules_contracts, rules_durability,
+        rules_jax, rules_protocol)
 
 
 def iter_py_files(paths: Iterable[str]) -> list:
@@ -179,6 +196,7 @@ def run(paths: Iterable[str], select: Optional[Iterable[str]] = None,
             findings.extend(checker(mod))
     for checker in _PROJECT_CHECKERS:
         findings.extend(checker(modules))
+    findings.extend(_stale_suppressions(modules, findings))
     kept = []
     for f in findings:
         if not _rule_selected(f.rule, select, ignore):
@@ -188,6 +206,40 @@ def run(paths: Iterable[str], select: Optional[Iterable[str]] = None,
             continue
         kept.append(f)
     return sorted(set(kept))
+
+
+def _stale_suppressions(modules, findings) -> list:
+    """DK001: a specific-rule suppression that matched no raw finding.
+
+    Works on the *pre-suppression* finding set — a suppression is live
+    iff the rule it names actually fires on its line (or anywhere in the
+    file, for ``disable-file``). Blanket ``*`` suppressions are exempt:
+    they state intent about the line, not about one rule's behavior.
+    """
+    out = []
+    by_mod: dict = {}
+    for f in findings:
+        by_mod.setdefault(f.path, set()).add((f.line, f.rule))
+    for mod in modules:
+        hits = by_mod.get(mod.path, set())
+        file_rules = {r for _ln, r in hits}
+        for line, rules in sorted(mod.suppressions.items()):
+            for rule in sorted(rules - {"*"}):
+                if (line, rule) not in hits:
+                    out.append(Finding(
+                        mod.path, line, 0, "DK001",
+                        f"stale suppression: {rule} can no longer fire on "
+                        "this line — remove the `# dk: disable` comment "
+                        "(or fix the rule ID)"))
+        for rule in sorted(mod.file_suppressions - {"*"}):
+            if rule not in file_rules:
+                out.append(Finding(
+                    mod.path, mod.file_suppression_lines.get(rule, 1), 0,
+                    "DK001",
+                    f"stale suppression: {rule} no longer fires anywhere "
+                    "in this file — remove the `# dk: disable-file` "
+                    "comment"))
+    return out
 
 
 def render(findings: list, fmt: str = "text") -> str:

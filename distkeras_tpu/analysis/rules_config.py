@@ -65,15 +65,70 @@ def check_env_discipline(mod: Module) -> list:
                     "in ENV_REGISTRY and read it through "
                     "config.env_bool/env_int/env_float/env_str"))
         registered = _registry_names()
+
+        def flag(node, name: str) -> None:
+            if name not in registered:
+                out.append(Finding(
+                    mod.path, node.lineno, node.col_offset, "DK302",
+                    f"`{name}` is not declared in "
+                    "runtime.config.ENV_REGISTRY: undeclared env "
+                    "vars bypass typing and the docs tables"))
+
+        fstring_parts: set = set()
         for node in ast.walk(mod.tree):
-            if isinstance(node, ast.Constant) and isinstance(node.value, str):
-                for name in _DKTPU_RE.findall(node.value):
-                    if name not in registered:
+            if isinstance(node, ast.JoinedStr):
+                # constant parts of an f-string never reach ast.Constant
+                # below (3.12+ folds them into the JoinedStr) — check the
+                # resolvable text and remember the parts we covered.
+                for i, part in enumerate(node.values):
+                    if (isinstance(part, ast.Constant)
+                            and isinstance(part.value, str)):
+                        fstring_parts.add(id(part))
+                        for name in _DKTPU_RE.findall(part.value):
+                            flag(part, name)
+                        # f"DKTPU_{name}": a bare prefix flowing into a
+                        # formatted value builds the name at runtime.
+                        if (re.search(r"DKTPU_[A-Z0-9_]*$", part.value)
+                                and i + 1 < len(node.values)
+                                and isinstance(node.values[i + 1],
+                                               ast.FormattedValue)):
+                            out.append(Finding(
+                                mod.path, part.lineno, part.col_offset,
+                                "DK302",
+                                "f-string builds a DKTPU_* env var name "
+                                "at runtime: no registry entry can ever "
+                                "match it — construct the full literal "
+                                "and declare it"))
+            elif (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Add)):
+                # `"DKTPU_" + name` concatenation: when both sides are
+                # constants the full name is checkable; a dynamic tail
+                # means the variable can never be matched to the registry
+                # at all — flag the construction itself.
+                left, right = node.left, node.right
+                if (isinstance(left, ast.Constant)
+                        and isinstance(left.value, str)
+                        and re.fullmatch(r"DKTPU_[A-Z0-9_]*",
+                                         left.value)):
+                    if (isinstance(right, ast.Constant)
+                            and isinstance(right.value, str)):
+                        for name in _DKTPU_RE.findall(
+                                left.value + right.value):
+                            flag(node, name)
+                    else:
                         out.append(Finding(
-                            mod.path, node.lineno, node.col_offset, "DK302",
-                            f"`{name}` is not declared in "
-                            "runtime.config.ENV_REGISTRY: undeclared env "
-                            "vars bypass typing and the docs tables"))
+                            mod.path, node.lineno, node.col_offset,
+                            "DK302",
+                            f"`{left.value}` + <dynamic> builds an env "
+                            "var name at runtime: no registry entry can "
+                            "ever match it — construct the full DKTPU_* "
+                            "literal and declare it"))
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and id(node) not in fstring_parts):
+                for name in _DKTPU_RE.findall(node.value):
+                    flag(node, name)
     return out
 
 

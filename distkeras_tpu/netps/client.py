@@ -332,7 +332,7 @@ class PSClient:
             # shared lock, which IS that lock (see __init__) — the
             # analyzer can't see through the callback indirection.
             self.shm_info = None  # dk: disable=DK202
-            self.walk_count += 1  # dk: disable=DK202 - same lock, above
+            self.walk_count += 1
             for conn in self._conns:
                 self._disconnect(conn)
 
@@ -671,7 +671,7 @@ class PSClient:
         # fresh client stays byte-identical to an untraced one; rejoins
         # and heartbeats carry the estimate forward.
         ct0 = self._clock_stamp(join_hdr)
-        hdr, center = self._rpc("join", join_hdr, list(init or ()))
+        hdr, center = self._rpc(wire.OP_JOIN, join_hdr, list(init or ()))
         if ct0 is not None:
             _traceclock.observe_reply(ct0, hdr, time.time())
         self.worker_id = int(hdr["worker_id"])
@@ -815,7 +815,7 @@ class PSClient:
                     return self._striped_pull()
                 with tracing.child_scope("pull.wire"):
                     hdr, center = self._rpc(
-                        "pull", self._traced(self._stamped({})))
+                        wire.OP_PULL, self._traced(self._stamped({})))
         except (LeaseExpiredError, EpochFencedError) as e:
             # Fenced reads exactly like evicted: the old lineage is gone;
             # re-join (walking to the promoted primary) and adopt.
@@ -838,7 +838,7 @@ class PSClient:
         ctx = tracing.current()
         for _ in range(_PULL_CONSISTENT_TRIES):
             futures = [
-                pool.submit(self._rpc_traced, ctx, "pull",
+                pool.submit(self._rpc_traced, ctx, wire.OP_PULL,
                             self._stamped({"shard": s,
                                            "num_shards": len(stripes),
                                            "idx": idx}), (), s)
@@ -856,7 +856,7 @@ class PSClient:
 
             telemetry.counter("netps.pull_torn_retries").add(1)
         # Persistent contention: one unsharded pull is always consistent.
-        hdr, center = self._rpc("pull", self._stamped({}))
+        hdr, center = self._rpc(wire.OP_PULL, self._stamped({}))
         return center, int(hdr["updates"])
 
     def _compress_delta(self, delta: Sequence[np.ndarray]) -> list:
@@ -910,7 +910,7 @@ class PSClient:
                     hdr = self._striped_commit(base, items)
                 else:
                     with tracing.child_scope("commit.wire"):
-                        hdr, _ = self._rpc("commit", self._traced(base),
+                        hdr, _ = self._rpc(wire.OP_COMMIT, self._traced(base),
                                            items)
             except (LeaseExpiredError, EpochFencedError) as e:
                 # Fenced commit = evicted commit: it was NEVER folded (the
@@ -959,7 +959,7 @@ class PSClient:
         ctx = tracing.current()
         futures = [
             pool.submit(
-                self._rpc_traced, ctx, "commit",
+                self._rpc_traced, ctx, wire.OP_COMMIT,
                 dict(base, shard=s, num_shards=len(stripes), idx=idx),
                 [items[i] for i in idx], s)
             for s, idx in enumerate(stripes)]
@@ -983,7 +983,7 @@ class PSClient:
         hb = self._stamped({})
         ct0 = self._clock_stamp(hb)
         try:
-            hdr, _ = self._rpc("heartbeat", hb)
+            hdr, _ = self._rpc(wire.OP_HEARTBEAT, hb)
         except (LeaseExpiredError, EpochFencedError) as e:
             if isinstance(e, EpochFencedError):
                 tracing.flight_dump("epoch_fenced")
@@ -1008,6 +1008,6 @@ class PSClient:
         """Best-effort clean departure (a dead server is not an error —
         leaving was the goal)."""
         try:
-            self._rpc("leave", {})
+            self._rpc(wire.OP_LEAVE, {})
         except (NetPSError, OSError):
             pass

@@ -524,22 +524,22 @@ class PSServer:
         # bytes in either direction.
         st1 = time.time() if "ct0" in header else None
         tctx = _tracing.header_ctx(header)
-        if op == "commit":
+        if op == wire.OP_COMMIT:
             self._chaos_hooks()
         with telemetry.span(f"netps.server.{op or 'unknown'}{dialect}"):
             with _tracing.adopt(tctx):
                 reply, out = self._dispatch(op, header, arrays)
         err = reply.get("error")
-        if op == "commit" and err == "epoch_fenced":
+        if op == wire.OP_COMMIT and err == "epoch_fenced":
             # The zero-stale-epoch-folds evidence: every fenced commit is
             # a commit that did NOT reach the fold.
             telemetry.counter("netps.failover.fenced_commits").add(1)
-        elif op == "replicate" and reply.get("mode") == "snapshot":
+        elif op == wire.OP_REPLICATE and reply.get("mode") == "snapshot":
             telemetry.counter("netps.failover.snapshot_syncs").add(1)
-        elif op == "fence" and reply.get("fenced"):
+        elif op == wire.OP_FENCE and reply.get("fenced"):
             telemetry.counter("netps.failover.fences_accepted").add(1)
             telemetry.event("netps_fenced", {"epoch": reply.get("epoch")})
-        if self._store is not None and op in ("commit", "join"):
+        if self._store is not None and op in (wire.OP_COMMIT, wire.OP_JOIN):
             telemetry.gauge("netps.recovery.snapshots").set(
                 float(self.snapshots_written))
         if st1 is not None:
@@ -561,7 +561,10 @@ class PSServer:
         arg = plan.fire("ps_hang", at)
         if arg:
             with self._lock:
-                time.sleep(arg)
+                # The whole point of ps_hang is to wedge the server WHILE
+                # holding the center lock — the hazard DK501 exists to
+                # catch is the drill here.
+                time.sleep(arg)  # dk: disable=DK501
         if plan.fire("ps_crash", at) is not None:
             os.kill(os.getpid(), signal.SIGKILL)
         if self.shard_index is not None:
@@ -577,19 +580,19 @@ class PSServer:
 
     def _dispatch(self, op: str, header: dict,
                   arrays: list) -> tuple[dict, list]:
-        if op == "join":
+        if op == wire.OP_JOIN:
             return self._op_join(header, arrays)
-        if op == "pull":
+        if op == wire.OP_PULL:
             return self._op_pull(header)
-        if op == "commit":
+        if op == wire.OP_COMMIT:
             return self._op_commit(header, arrays)
-        if op == "heartbeat":
+        if op == wire.OP_HEARTBEAT:
             return self._op_heartbeat(header)
-        if op == "leave":
+        if op == wire.OP_LEAVE:
             return self._op_leave(header)
-        if op == "replicate":
+        if op == wire.OP_REPLICATE:
             return self._op_replicate(header)
-        if op == "fence":
+        if op == wire.OP_FENCE:
             return self._op_fence(header)
         if op == wire.OP_PROBE:
             return self._op_probe(header, arrays)

@@ -250,7 +250,7 @@ class ShardedPSClient:
     def _fetch_plan(self) -> None:
         """Observer bootstrap: pull shard 0's plan advertisement without
         joining (membership-free, like the anonymous observer pull)."""
-        hdr, _ = self._subs[0]._rpc("pull", {"want_plan": True})
+        hdr, _ = self._subs[0]._rpc(wire.OP_PULL, {"want_plan": True})
         info = hdr.get("sharding")
         if not isinstance(info, dict):
             raise ShardPlanError(

@@ -141,7 +141,7 @@ class StandbyServer(PSServer):
                 req += 1
                 sock.settimeout(self.rpc_timeout)
                 wire.send_frame(sock, wire.KIND_REQUEST,
-                                {"op": "replicate", "u": self._next_u(),
+                                {"op": wire.OP_REPLICATE, "u": self._next_u(),
                                  "req": req}, [])
                 rhdr, rarrays = self._recv_reply(sock, req)
                 err = rhdr.get("error")
@@ -336,7 +336,7 @@ class StandbyServer(PSServer):
                         wire.split_endpoint(self.primary_endpoint),
                         timeout=self.rpc_timeout) as sock:
                     wire.send_frame(sock, wire.KIND_REQUEST,
-                                    {"op": "fence", "epoch": epoch,
+                                    {"op": wire.OP_FENCE, "epoch": epoch,
                                      "req": 1}, [])
                     sock.settimeout(self.rpc_timeout)
                     rhdr, _ = self._recv_reply(sock, 1)

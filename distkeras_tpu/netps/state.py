@@ -243,7 +243,9 @@ class StateStore:
         same arithmetic. Blocks only when the writer is ``_WRITE_QUEUE``
         records behind — the crash-loss window and the queued-delta memory
         both stay bounded."""
-        hdr = {"op": "journal", "u": int(updates), "wid": int(wid),
+        # "journal" is the on-disk record tag, not an RPC op kind.
+        hdr = {"op": "journal", "u": int(updates),  # dk: disable=DK401
+               "wid": int(wid),
                "seq": int(seq), "st": int(staleness), "e": int(epoch),
                "n": int(commits_total)}
         if self._writer is None:
@@ -305,7 +307,9 @@ class StateStore:
         self.barrier()
         path = os.path.join(self.state_dir,
                             _name(_SNAP_PREFIX, updates, _SNAP_SUFFIX))
-        hdr = {"op": "snapshot", "updates": int(updates),
+        # "snapshot" is the on-disk record tag, not an RPC op kind.
+        hdr = {"op": "snapshot",  # dk: disable=DK401
+               "updates": int(updates),
                "last_seq": {str(k): int(v) for k, v in last_seq.items()},
                "epoch": int(epoch), "commits_total": int(commits_total)}
         tmp = path + ".tmp"

@@ -60,7 +60,7 @@ import json
 import socket
 import struct
 import zlib
-from typing import Optional, Sequence
+from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
 
@@ -126,6 +126,19 @@ CAPS = {"codecs": list(CODECS), "striping": True, "shm": True,
         "replication": True, "serving": True, "sharding": True,
         "tuner": True, "tracing": True}
 
+#: the core parameter-server ops (``header["op"]``). Every op constant in
+#: the package MUST be declared in :data:`OP_REGISTRY` below — dk-check's
+#: DK401 fails the build on drift, in either direction.
+OP_JOIN = "join"
+OP_PULL = "pull"
+OP_COMMIT = "commit"
+OP_HEARTBEAT = "heartbeat"
+OP_LEAVE = "leave"
+
+#: warm-standby replication + failover fencing (``CAPS["replication"]``).
+OP_REPLICATE = "replicate"
+OP_FENCE = "fence"
+
 #: serving-plane ops carried in ``header["op"]`` over the SAME frame
 #: format (length prefix, crc32, request-id echo) — the serving frontend
 #: speaks the wire protocol, not a second one.
@@ -134,6 +147,83 @@ OP_STATS = "stats"
 
 #: the tuner's timed micro-A/B round trip (see ``CAPS["tuner"]``).
 OP_PROBE = "probe"
+
+
+class OpSpec(NamedTuple):
+    """One op's wire contract, as declared in :data:`OP_REGISTRY`.
+
+    ``cap`` is the :data:`CAPS` key whose advertisement gates the op
+    (``None`` = core protocol, every peer answers it); ``replies`` are the
+    distinguished reply-header keys a handler may answer the op with, on
+    top of the keys every reply may carry (``ok``/``error``/``message``/
+    ``req`` and the clock echo ``st1``/``st2``)."""
+
+    cap: Optional[str]
+    replies: tuple
+
+
+#: THE op vocabulary: one declaration per op, its CAPS gate, and its reply
+#: shape. ``netps/server.py`` dispatches these and nothing else; an op
+#: constant without a registry row (or a row without a constant) is
+#: protocol drift and a DK401 finding.
+OP_REGISTRY = {
+    OP_JOIN: OpSpec(None, ("worker_id", "updates", "lease_s", "last_seq",
+                           "epoch", "caps")),
+    OP_PULL: OpSpec(None, ("updates", "plan_hash", "sharding")),
+    OP_COMMIT: OpSpec(None, ("applied", "duplicate", "pending", "updates",
+                             "staleness")),
+    OP_HEARTBEAT: OpSpec(None, ("updates",)),
+    OP_LEAVE: OpSpec(None, ()),
+    OP_REPLICATE: OpSpec("replication",
+                         ("mode", "records", "updates", "epoch", "lineage",
+                          "commits_total", "last_seq")),
+    OP_FENCE: OpSpec("replication", ("fenced", "epoch")),
+    OP_INFER: OpSpec("serving", ("arrays", "error")),
+    OP_STATS: OpSpec(None, ("caps", "role", "snapshot", "ring", "updates",
+                            "epoch", "members", "commits_total", "draining",
+                            "ready")),
+    OP_PROBE: OpSpec("tuner", ("probe_bytes", "decode_s")),
+}
+
+#: every typed ``error`` kind a reply header may carry — the netps server's
+#: vocabulary (``netps/errors.py`` types) plus the serving plane's
+#: (``serving/errors.py``, same frames, same key). A handler answering a
+#: kind outside this set is a DK402 finding: clients match on these
+#: strings, so an undeclared kind is an untyped failure.
+ERROR_KINDS = frozenset({
+    # netps core (netps/errors.py)
+    "protocol", "draining", "lease_expired", "uninitialized",
+    "not_primary", "epoch_fenced", "shard_plan",
+    # serving plane (serving/errors.py)
+    "overloaded", "deadline", "unavailable", "serving",
+})
+
+#: every frame-header key either side may read or write — request fields,
+#: reply fields, the replication-record sub-headers, and the trace/clock
+#: plumbing. Handlers indexing a header with a key outside this set is a
+#: DK402 finding (a typo'd key reads as an absent optional field and fails
+#: silently; the registry turns it into a build failure).
+HEADER_KEYS = frozenset({
+    # envelope + request/reply bookkeeping
+    "op", "req", "ok", "error", "message", "arrays", "version",
+    # membership + commit protocol
+    "worker_id", "seq", "pulled", "updates", "lease_s", "last_seq",
+    "applied", "duplicate", "pending", "staleness", "epoch", "caps",
+    # striping
+    "num_shards", "shard", "idx",
+    # replication / failover
+    "u", "mode", "records", "lineage", "commits_total", "fenced",
+    "wid", "st", "e", "n", "k", "tr",
+    # sharded center
+    "want_plan", "plan_hash", "sharding", "shard_index", "shard_plan",
+    "plan", "index", "count",
+    # stats / health scrape
+    "ring", "role", "snapshot", "members", "draining", "ready",
+    # tuner probe
+    "probe_bytes", "decode_s",
+    # tracing + clock exchange
+    "trace", "parent", "ct0", "st1", "st2",
+})
 
 
 # ---------------------------------------------------------------------------
@@ -173,6 +263,14 @@ OP_PROBE = "probe"
 SHM_MAGIC = 0x444B5348  # 'DKSH'
 SHM_VERSION = 1
 _SHM_SLOT = struct.Struct("!IIIIQQ")  # magic, version, seq, crc32, length, rsvd
+#: single network-order u32 — the declared accessor for in-place reads and
+#: writes of individual slot fields (and the frame's HLEN word). Packing
+#: outside this module is a DK403 finding; transports use these instead.
+U32 = struct.Struct("!I")
+#: byte offsets of the seqlock and crc fields inside ``_SHM_SLOT`` (the
+#: two fields the ring writer/reader touch individually).
+SHM_SEQ_OFF = 8
+SHM_CRC_OFF = 12
 SHM_SLOT_HEADER = _SHM_SLOT.size
 _SHM_DOORBELL = struct.Struct("!Q")  # frame length rung across the UDS
 SHM_DOORBELL_SIZE = _SHM_DOORBELL.size

@@ -24,3 +24,13 @@ def documented_and_registered() -> str:
     from distkeras_tpu.runtime import config
 
     return config.env_str("DKTPU_FAULTS")
+
+
+def stale_marker():
+    return 1  # dk: disable=DK301  # PLANT: DK001
+
+
+def dynamic_env_names(suffix):
+    key = f"DKTPU_TUNE_{suffix}"  # PLANT: DK302
+    prefix = "DKTPU_" + suffix  # PLANT: DK302
+    return key, prefix

@@ -1,0 +1,13 @@
+"""Planted DK6xx violations for tests/test_analysis.py (parsed, never
+run): telemetry names outside telemetry/registry.py's declarations."""
+
+from distkeras_tpu import telemetry
+
+
+def record(step, shard):
+    telemetry.counter("training.not_a_metric").add(1)  # PLANT: DK601
+    telemetry.histogram(f"made.up.{step}").observe(0.1)  # PLANT: DK601
+    telemetry.gauge(f"fleet.round.{shard}").set(1)  # PLANT: DK601
+    telemetry.counter("netps.commits").add(1)  # negative: declared
+    with telemetry.span(f"netps.rpc.{step}"):  # negative: dynamic prefix
+        pass

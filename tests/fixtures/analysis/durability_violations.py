@@ -1,0 +1,52 @@
+"""Planted DK5xx violations (parsed, never run): the minimized PR 6
+resolve-backend-under-center-lock repro and the ACK-before-journal shape
+the OffsetJournal discipline forbids."""
+
+import os
+import threading
+import time
+
+from distkeras_tpu.netps.fold import resolve_backend
+
+
+class MiniCenter:
+    """PR 6 in miniature: ``self._lock`` guards the center, and the fold
+    path resolves the accelerator backend while holding it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._center = None
+        self._updates = 0
+
+    def fold(self, delta):
+        with self._lock:
+            resolve_backend()  # PLANT: DK501
+            self._center = list(delta)
+
+    def snooze(self):
+        with self._lock:
+            time.sleep(0.1)  # PLANT: DK501
+            self._center = None
+
+    def fold_resolved(self, delta, backend):
+        resolve_backend()  # negative control: resolve BEFORE the lock
+        with self._lock:
+            self._center = list(delta)
+
+
+class AckFirstIngest:
+    def ingest(self, client, journal, wid, seq, offset):
+        client.commit(offset)  # PLANT: DK502
+        journal.intent(wid, seq, offset)
+
+    def persist(self, sock, fh):
+        sock.sendall(b"ok")  # PLANT: DK502
+        os.fsync(fh.fileno())
+
+    def ingest_properly(self, client, journal, wid, seq, offset):
+        journal.intent(wid, seq, offset)  # negative: intent-before-RPC
+        client.commit(offset)
+
+
+def stale_suppressed():
+    return 1  # dk: disable=DK501  # PLANT: DK001

@@ -1,0 +1,23 @@
+"""Planted OP_REGISTRY drift (the wire.py half of DK401; parsed, never
+run): an undeclared constant, a ghost registry key, and an undeclared cap
+gate."""
+
+from typing import NamedTuple
+
+
+class OpSpec(NamedTuple):
+    cap: str
+    reply_keys: tuple
+
+
+CAPS = {"base": True}
+
+OP_ALPHA = "alpha"
+OP_BETA = "beta"  # PLANT: DK401
+OP_DELTA = "delta"
+
+OP_REGISTRY = {  # PLANT: DK401
+    OP_ALPHA: OpSpec("base", ()),
+    "gamma": OpSpec("base", ()),
+    OP_DELTA: OpSpec("ghost_cap", ()),  # PLANT: DK401
+}

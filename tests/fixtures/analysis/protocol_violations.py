@@ -1,0 +1,37 @@
+"""Planted DK4xx violations for tests/test_analysis.py (parsed, never run).
+
+Importing ``distkeras_tpu.netps`` puts this module on the wire plane, which
+is what scopes DK401/DK402/DK403 onto it.
+"""
+
+import struct
+
+from distkeras_tpu.netps import wire
+
+
+def dispatch(srv, op, hdr, reply):
+    if op == "comit":  # PLANT: DK401
+        return None
+    if hdr["op"] == "fence":  # PLANT: DK401
+        return None
+    if op == wire.OP_PULL:  # negative control: the declared constant
+        return hdr.get("worker_id")  # negative control: declared key
+    if hdr.get("branch_id"):  # PLANT: DK402
+        return reply["wrong_key"]  # PLANT: DK402
+    if reply.get("error") == "not_an_error":  # PLANT: DK402
+        return srv._err("nonsense", "boom")  # PLANT: DK402
+    return srv._err("protocol", "ok")  # negative control: declared kind
+
+
+def send(client, hdr):
+    client._rpc("join", hdr)  # PLANT: DK401
+    frame = {"op": "pull"}  # PLANT: DK401
+    return frame
+
+
+OP_FROB = "frob"  # PLANT: DK401
+
+
+def pack_ad_hoc(n):
+    header = struct.pack("<I", n)  # PLANT: DK403
+    return header + wire.U32.pack(n)  # negative control: wire's layout

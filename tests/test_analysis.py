@@ -67,7 +67,7 @@ def test_every_rule_family_is_exercised():
     by_family = {}
     for f in findings:
         by_family.setdefault(f.rule[:3], []).append(f.rule)
-    for family in ("DK1", "DK2", "DK3"):
+    for family in ("DK0", "DK1", "DK2", "DK3", "DK4", "DK5", "DK6"):
         assert len(by_family.get(family, [])) >= 2, by_family
 
 
@@ -99,8 +99,11 @@ def test_select_ignore_and_syntax_error(tmp_path):
     fix = os.path.join(FIXTURES, "config_violations.py")
     only_302 = run([fix], select=["DK302"])
     assert only_302 and all(f.rule == "DK302" for f in only_302)
+    # DK001 (the stale-suppression meta-rule) survives ignore=DK3:
+    # staleness is a property of the code, not of the filter view.
     no_3xx = run([fix], ignore=["DK3"])
-    assert no_3xx == []
+    assert [f.rule for f in no_3xx] == ["DK001"]
+    assert run([fix], ignore=["DK3", "DK0"]) == []
 
 
 def test_cli_roundtrip(tmp_path, capsys):
@@ -258,3 +261,161 @@ def test_package_lock_graph_is_acyclic_and_witnessed_subset():
                 if e[0].split(".")[0] in pkg_bases
                 or e[1].split(".")[0] in pkg_bases}
     assert observed <= static_edges, observed - static_edges
+
+
+# -- DK001 stale suppressions ----------------------------------------------
+
+def test_stale_suppression_fires_and_live_one_does_not(tmp_path):
+    p = tmp_path / "stale.py"
+    p.write_text(
+        "def f(q):\n"
+        "    try:\n"
+        "        q.get()\n"
+        "    except:  # dk: disable=DK204\n"      # live: DK204 fires here
+        "        pass\n"
+        "x = 1  # dk: disable=DK204\n")           # stale: it cannot
+    findings = run([str(p)])
+    assert [(f.line, f.rule) for f in findings] == [(6, "DK001")]
+
+
+def test_stale_file_suppression_points_at_its_comment(tmp_path):
+    p = tmp_path / "stale_file.py"
+    p.write_text("x = 1\n# dk: disable-file=DK301\ny = 2\n")
+    findings = run([str(p)])
+    assert [(f.line, f.rule) for f in findings] == [(2, "DK001")]
+
+
+def test_blanket_suppression_is_exempt_from_dk001(tmp_path):
+    p = tmp_path / "blanket.py"
+    p.write_text("x = 1  # dk: disable\n")
+    assert run([str(p)]) == []
+
+
+# -- metric registry -------------------------------------------------------
+
+def test_metric_registry_declares_and_renders():
+    from distkeras_tpu.telemetry import registry
+
+    assert registry.declared("counter", "netps.commits")
+    assert not registry.declared("gauge", "netps.commits")  # kind-checked
+    assert not registry.declared("counter", "netps.nope")
+    assert registry.declared_prefix("span", "netps.rpc.")
+    assert not registry.declared_prefix("counter", "made.up.")
+    table = registry.render_metric_table("netps")
+    assert "`netps.commits`" in table and "`netps.rpc.*`" in table
+    doc = ("<!-- dk-metric:begin category=netps -->\nOUTDATED\n"
+           "<!-- dk-metric:end -->")
+    spliced = registry.splice_metric_docs(doc)
+    assert "OUTDATED" not in spliced and "`netps.commits`" in spliced
+    with pytest.raises(ValueError):
+        registry.splice_metric_docs("no markers", path_hint="f.md")
+
+
+def test_metric_docs_drift_is_a_finding(tmp_path, monkeypatch):
+    """DK602 fires when a docs metric block goes stale (checked against a
+    scratch docs tree so the real one stays untouched)."""
+    from distkeras_tpu.analysis import rules_contracts
+
+    reg_path = os.path.join(PKG_DIR, "telemetry", "registry.py")
+    modules, errs = core.parse_modules([reg_path])
+    assert not errs
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "OBS.md").write_text(
+        "<!-- dk-metric:begin category=netps -->\nstale\n"
+        "<!-- dk-metric:end -->\n")
+    monkeypatch.setattr(rules_contracts, "_docs_dir_for",
+                        lambda _p: str(docs))
+    findings = rules_contracts.check_metric_docs(modules)
+    assert any("stale vs the registry" in f.message for f in findings)
+    assert any("registered but appears in no docs" in f.message
+               for f in findings)
+
+
+def test_fault_kind_drift_is_a_finding(tmp_path, monkeypatch):
+    """DK603 both directions: an undocumented code kind and a documented
+    ghost row."""
+    from distkeras_tpu.analysis import rules_contracts
+
+    faults_path = os.path.join(PKG_DIR, "resilience", "faults.py")
+    modules, errs = core.parse_modules([faults_path])
+    assert not errs
+    assert rules_contracts.check_fault_kinds(modules) == []  # real docs ok
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "RESILIENCE.md").write_text(
+        "| `preempt@R` | x | y |\n| `ghost_fault@R` | x | y |\n")
+    monkeypatch.setattr(rules_contracts, "_docs_dir_for",
+                        lambda _p: str(docs))
+    findings = rules_contracts.check_fault_kinds(modules)
+    assert any("has no row" in f.message for f in findings)
+    assert any("ghost_fault" in f.message and "stale docs row" in f.message
+               for f in findings)
+
+
+# -- interleaving checker --------------------------------------------------
+
+def test_explorer_enumerates_exact_schedule_count():
+    """2 threads x 2 steps = C(4,2) = 6 complete schedules; with crash
+    points every proper non-empty prefix adds one crashed run."""
+    from distkeras_tpu.analysis import interleave
+
+    class Tiny(interleave.Scenario):
+        name = "tiny"
+
+        def build(self, factory):
+            self.log = []
+
+            def script(tag):
+                def gen():
+                    self.log.append((tag, 0))
+                    yield
+                    self.log.append((tag, 1))
+                return gen
+            factory(target=script("a"), name="a")
+            factory(target=script("b"), name="b")
+
+    res = interleave.explore(Tiny)
+    assert (res.complete, res.crashed) == (6, 0)
+    res = interleave.explore(Tiny, crash_points=True)
+    # one crash per distinct non-empty proper prefix: 2 + 4 + 6 = 12
+    assert (res.complete, res.crashed) == (6, 12)
+    assert res.violations == []
+
+
+def test_interleave_scenarios_hold_invariants():
+    from distkeras_tpu.analysis import interleave
+
+    results = interleave.run_suite()
+    by_name = {r.name: r for r in results}
+    assert by_name["dedup"].complete == 924       # 12!/(6!6!)
+    assert by_name["fence"].complete == 11550     # 11!/(4!4!3!)
+    assert by_name["journal"].complete == 924
+    assert by_name["journal"].crashed > 2000      # crash at every prefix
+    total = sum(r.schedules for r in results)
+    assert total >= 10_000
+    for r in results:
+        assert r.violations == [], r.violations[:3]
+
+
+def test_interleave_catches_seeded_dedup_mutation():
+    """A server that forgets its dedup table must produce exactly-once
+    violations — the checker's own regression test."""
+    from distkeras_tpu.analysis import interleave
+
+    res = interleave.explore(
+        lambda: interleave.DedupScenario(interleave._NoDedupServer),
+        max_schedules=50)
+    assert res.violations, "mutated server not caught"
+    assert any("folded" in v.message or "duplicate fold" in v.message
+               for v in res.violations)
+
+
+def test_interleave_cli(capsys):
+    from distkeras_tpu.analysis import interleave
+
+    assert interleave.main(["--scenario", "dedup"]) == 0
+    out = capsys.readouterr().out
+    assert "924 complete schedules" in out and "state space" in out
+    assert interleave.main(["--scenario", "dedup", "--mutate"]) == 0
+    assert "CAUGHT" in capsys.readouterr().out
