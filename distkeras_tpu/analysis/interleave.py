@@ -3,8 +3,9 @@
 dk-check's lint rules (DK2xx/DK5xx) reason about lock/ordering hazards
 *lexically*; this module closes the loop dynamically: it enumerates EVERY
 schedule of a small cooperative-thread scenario against the REAL protocol
-machines — ``netps.server.PSServer``'s dedup table and epoch fence, and
-``streaming.journal.OffsetJournal``'s crash-recovery ``resolve()`` — and
+machines — ``netps.server.PSServer``'s dedup table and epoch fence,
+``streaming.journal.OffsetJournal``'s crash-recovery ``resolve()``, and
+``netps.hier.AggregatorServer``'s combined-window flush plane — and
 asserts the exactly-once and fence-monotonicity invariants in every one.
 
 The concurrency seam is the same one the fleet simulator fills
@@ -618,6 +619,161 @@ class JournalScenario(Scenario):
 
 
 # ---------------------------------------------------------------------------
+# Scenario 4: the aggregation tree's flush plane (no window folded twice
+# at the root)
+# ---------------------------------------------------------------------------
+
+class TreeFlushScenario(Scenario):
+    """An aggregator's flush racing its children's retransmits AND an
+    upstream eviction: 2 children each send one commit twice (the
+    lost-ACK retransmit) into a real ``AggregatorServer`` whose uplink
+    dials a real, served root ``PSServer``; a flusher thread forwards
+    combined windows (``_flush_once(force=True)`` — the tree node's
+    drain path runs the same code); an evictor revokes the aggregator's
+    root lease once (``revoke()`` — the deterministic stand-in for a
+    lease lapse), so a flush can land evicted at any point relative to
+    the absorbs. 7 steps, 630 schedules.
+
+    The aggregator is never ``start()``ed (no real flusher thread, no
+    heartbeats), so the explorer owns every interleaving; uplink RPCs
+    are synchronous inside one atomic step, so the root is quiescent at
+    every check point.
+
+    Invariants: no child ``(wid, seq)`` double-absorbed at the
+    aggregator; no combined window folded twice at the root (root
+    commit_log pair uniqueness AND ``root.commits_total ==
+    agg.forwarded``); the window-conservation ledger balances at EVERY
+    step (``absorbed == forwarded_commits + lost_commits + open``) — an
+    evicted flush must show up as a counted loss, never a silent gap,
+    and never a re-fold."""
+
+    name = "tree_flush"
+    WORKERS = 2
+    COMMITS = 1  # per child, each sent twice
+    FLUSHES = 2
+
+    def build(self, thread_factory: CoopThreadFactory) -> None:
+        from distkeras_tpu.netps.hier import AggregatorServer
+
+        self.root = _new_server()
+        self.root.start()
+        self.agg = AggregatorServer(self.root.endpoint, lease_s=3600.0,
+                                    flush_interval=3600.0)
+        self.wids = list(range(self.WORKERS))
+        for w in self.wids:
+            _join(self.agg, w)
+        self._prev_root_total = self.root.commits_total
+        for w in self.wids:
+            thread_factory(target=self._child(w), name=f"c{w}")
+        thread_factory(target=self._flusher, name="flusher")
+        thread_factory(target=self._evictor, name="evictor")
+
+    def _child(self, wid: int):
+        # original then lost-ACK retransmit, serially — the real client's
+        # retry-then-advance loop against the AGGREGATOR, not the root
+        sends = [(seq, attempt) for seq in range(self.COMMITS)
+                 for attempt in (0, 1)]
+
+        def script():
+            for i, (seq, _attempt) in enumerate(sends):
+                if i:
+                    yield
+                _commit(self.agg, wid, seq)
+        return script
+
+    def _flusher(self):
+        for i in range(self.FLUSHES):
+            if i:
+                yield
+            self.agg._flush_once(force=True)
+
+    def _evictor(self):
+        # The aggregator's root lease lapses mid-run: membership dropped
+        # NOW, its next uplink RPC answers evicted (the client re-joins,
+        # the in-flight window is a counted loss — never a retransmit).
+        self.root.revoke(self.agg._up.worker_id)
+        return
+        yield  # pragma: no cover - makes the target a generator fn
+
+    def check_step(self) -> List[str]:
+        out = []
+        agg_pairs = _fold_pairs(self.agg)
+        if len(set(agg_pairs)) != len(agg_pairs):
+            out.append(f"child commit double-absorbed: {agg_pairs}")
+        root_pairs = _fold_pairs(self.root)
+        if len(set(root_pairs)) != len(root_pairs):
+            out.append(f"window folded twice at root: {root_pairs}")
+        if self.root.commits_total < self._prev_root_total:
+            out.append(f"root commits_total regressed: "
+                       f"{self._prev_root_total} -> "
+                       f"{self.root.commits_total}")
+        self._prev_root_total = self.root.commits_total
+        ledger = (self.agg.forwarded_commits + self.agg.lost_commits
+                  + self.agg._acc_count)
+        if self.agg.absorbed != ledger:
+            out.append(f"conservation broken: absorbed={self.agg.absorbed} "
+                       f"!= forwarded {self.agg.forwarded_commits} + lost "
+                       f"{self.agg.lost_commits} + open "
+                       f"{self.agg._acc_count}")
+        return out
+
+    def finish(self) -> None:
+        # The tree node's close-path drain: one forced flush empties the
+        # open window (forwarded, or a counted loss if it lands evicted).
+        self.agg._flush_once(force=True)
+
+    def check_final(self) -> List[str]:
+        out = []
+        want = self.WORKERS * self.COMMITS
+        agg_pairs = _fold_pairs(self.agg)
+        for w in self.wids:
+            for seq in range(self.COMMITS):
+                n = agg_pairs.count((w, seq))
+                if n != 1:
+                    out.append(f"child (wid={w}, seq={seq}) absorbed {n} "
+                               "times, want exactly 1")
+        if self.agg.absorbed != want:
+            out.append(f"absorbed={self.agg.absorbed}, want {want}")
+        if self.agg._acc_count:
+            out.append(f"open window survived the forced drain: "
+                       f"{self.agg._acc_count} commits")
+        if (self.agg.forwarded_commits + self.agg.lost_commits
+                != self.agg.absorbed):
+            out.append(f"final ledger: forwarded {self.agg.forwarded_commits}"
+                       f" + lost {self.agg.lost_commits} != absorbed "
+                       f"{self.agg.absorbed}")
+        if self.root.commits_total != self.agg.forwarded:
+            out.append(f"root folded {self.root.commits_total} combined "
+                       f"commits, aggregator forwarded "
+                       f"{self.agg.forwarded} — a window folded twice or "
+                       "vanished")
+        root_pairs = _fold_pairs(self.root)
+        if len(set(root_pairs)) != len(root_pairs):
+            out.append(f"window folded twice at root: {root_pairs}")
+        return out
+
+    def close(self) -> None:
+        import socket
+
+        try:
+            self.agg._up.leave()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+        self.agg._up.close()
+        _close_server(self.agg)
+        # Poke the root's accept loop awake before joining it — without
+        # this every schedule pays the full accept-poll interval in
+        # close(), and 630 schedules of it blows the CI budget.
+        self.root._stop.set()
+        try:
+            host, port = self.root.endpoint.rsplit(":", 1)
+            socket.create_connection((host, int(port)), timeout=1.0).close()
+        except OSError:
+            pass
+        self.root.close()
+
+
+# ---------------------------------------------------------------------------
 # Suite + CLI
 # ---------------------------------------------------------------------------
 
@@ -625,6 +781,7 @@ SCENARIOS = {
     "dedup": lambda: (DedupScenario, False),
     "fence": lambda: (FenceScenario, False),
     "journal": lambda: (JournalScenario, True),
+    "tree_flush": lambda: (TreeFlushScenario, False),
 }
 
 
@@ -645,7 +802,8 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m distkeras_tpu.analysis.interleave",
         description="exhaustively model-check the dedup / fence / "
-                    "journal machines across every thread interleaving")
+                    "journal / tree-flush machines across every thread "
+                    "interleaving")
     parser.add_argument("--scenario", action="append", default=None,
                         choices=sorted(SCENARIOS),
                         help="run only this scenario (repeatable)")

@@ -19,7 +19,11 @@ never implemented):
   progress or exactly-once commit semantics;
 * :mod:`~distkeras_tpu.fleet.ports` — the per-host bind-probed port
   pool (:func:`reserve_port`) that lets two jobs' servers coexist on
-  one host (threaded through ``Punchcard.ps_endpoint``).
+  one host (threaded through ``Punchcard.ps_endpoint``);
+* :mod:`~distkeras_tpu.fleet.placement` — aggregation-tree gang
+  placement (:func:`place_tree`): every interior ``TreeSpec`` node on
+  the first host of its own subtree, its warm standby region-local on
+  the next, ports from the pool, endpoints failover-complete.
 
 Per-tenant telemetry attribution rides on metric names
 (``fleet.<metric>.<tenant>.<job>``) and ambient
@@ -38,6 +42,11 @@ from distkeras_tpu.fleet.job import (  # noqa: F401
     RUNNING,
     FleetJob,
 )
+from distkeras_tpu.fleet.placement import (  # noqa: F401
+    NodePlacement,
+    TreePlacement,
+    place_tree,
+)
 from distkeras_tpu.fleet.ports import (  # noqa: F401
     PortPool,
     release_port,
@@ -52,5 +61,6 @@ from distkeras_tpu.fleet.scheduler import (  # noqa: F401
 __all__ = [
     "FleetScheduler", "FleetJob", "ElasticTraining",
     "PortPool", "reserve_port", "release_port", "parse_quotas",
+    "NodePlacement", "TreePlacement", "place_tree",
     "QUEUED", "RUNNING", "DRAINING", "DONE", "FAILED",
 ]

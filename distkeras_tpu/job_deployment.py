@@ -55,6 +55,17 @@ class Punchcard:
     carries the comma-separated ``primary,standby`` list their hardened
     clients walk on failure.
 
+    Aggregation tree (``tree: "host:8,region:2"`` — the
+    ``DKTPU_TREE_SPEC`` grammar): the job additionally gets a gang of
+    interior tree nodes, placed by ``fleet.placement.place_tree`` (each
+    node on the first host of its own subtree, its warm ``TreeStandby``
+    region-local on the next, ports pool-allocated and released with the
+    card's). Workers then dial their OWN level-0 node's
+    ``primary,standby`` list instead of the root — :meth:`tree_plan` /
+    ``Job.render_tree_commands`` carry the whole shape, and every
+    worker's env also mirrors ``DKTPU_TREE_SPEC``. ``tree_buffer``
+    (optional) sets each node's partition ride-through bound.
+
     Sharded center (``shards: N`` with N > 1): the job gets a GANG of N
     shard servers instead of one — each launched ``--shard k/N`` with its
     own pool-allocated port, per-shard state dir (``<state_dir>/shard-k``)
@@ -103,6 +114,7 @@ class Punchcard:
         from distkeras_tpu.fleet.ports import release_port
 
         allocated = set(self.__dict__.pop("_allocated_ports", []))
+        self.__dict__.pop("_tree_plan", None)  # its ports are in the set
         for port in allocated:
             release_port(port)
         if self.coordinator_port in allocated:
@@ -189,6 +201,33 @@ class Punchcard:
         standby = self.ps_standby_endpoint()
         return f"{primary},{standby}" if standby else primary
 
+    def tree_spec(self) -> Optional[str]:
+        """The card's aggregation-tree grammar (``ps["tree"]``), None when
+        the job runs the flat star."""
+        if self.ps is None:
+            return None
+        return self.ps.get("tree") or None
+
+    def tree_plan(self):
+        """The resolved :class:`~distkeras_tpu.fleet.placement.
+        TreePlacement` for a ``tree`` card (None otherwise) — sticky like
+        every port pin: the first call reserves the gang's ports through
+        this card (so :meth:`release_ports` returns them) and every later
+        call, launch line, and worker env agrees with it."""
+        spec = self.tree_spec()
+        if not spec:
+            return None
+        plan = self.__dict__.get("_tree_plan")
+        if plan is None:
+            from distkeras_tpu.fleet.placement import place_tree
+
+            plan = place_tree(spec, workers=len(self.hosts),
+                              hosts=list(self.hosts),
+                              root_endpoint=self.ps_endpoint(),
+                              reserve=self._reserve)
+            self.__dict__["_tree_plan"] = plan
+        return plan
+
     def ps_standby_endpoint(self) -> Optional[str]:
         """``host:port`` of the warm standby, None when not configured.
         Like the primary's, a missing ``standby_port`` is pool-allocated
@@ -240,6 +279,10 @@ class Job:
         #: attributes above.
         self._shard_procs: list = []
         self._shard_standby_procs: list = []
+        #: the interior tree-node gang (punchcards with ``ps["tree"]``):
+        #: one TreeNode per (level, group) plus its warm TreeStandby,
+        #: launched parents-first, torn down with the PS plane.
+        self._tree_procs: list = []
         #: restarts performed per host by :meth:`supervise`.
         self.restarts: list[int] = []
         #: PS-pair restarts performed by :meth:`supervise` (cold restarts
@@ -255,13 +298,19 @@ class Job:
         pc = self.punchcard
         coordinator = f"{pc.hosts[0]}:{pc.resolved_coordinator_port()}"
         endpoint = pc.ps_endpoint()
+        tree = pc.tree_plan()
         cmds = []
         for i, _host in enumerate(pc.hosts):
+            # A tree card's worker dials its OWN level-0 node (its host's
+            # subtree), not the root — the node's standby rides along in
+            # the failover list.
+            ep = tree.leaf_endpoint(i) if tree else endpoint
             env = {
                 "JAX_COORDINATOR_ADDRESS": coordinator,
                 "JAX_NUM_PROCESSES": str(len(pc.hosts)),
                 "JAX_PROCESS_ID": str(i),
-                **({"DKTPU_PS_ENDPOINT": endpoint} if endpoint else {}),
+                **({"DKTPU_PS_ENDPOINT": ep} if ep else {}),
+                **({"DKTPU_TREE_SPEC": pc.tree_spec()} if tree else {}),
                 # With tracing on, every child's spans/flight dumps carry
                 # a fleet-unique role label (workers here; the netps CLI
                 # self-labels ps/shardK/standby). Before ``pc.env`` so an
@@ -356,6 +405,49 @@ class Job:
         cmds = self.render_standby_commands()
         return cmds[0] if cmds else None
 
+    def render_tree_commands(self) -> list[str]:
+        """One launch line per interior tree node AND its warm standby
+        (``ps["tree"]`` cards; empty otherwise), bottom level first with
+        each node's standby right after it. Launch order matters top-down
+        — parents must listen before children dial — so a launcher runs
+        this list REVERSED; per-node state dirs are
+        ``<state_dir>/tree-L<level>-g<group>`` (standby: ``.standby``
+        suffix), the same labels the placement's ``all_state_labels``
+        exports."""
+        pc = self.punchcard
+        plan = pc.tree_plan()
+        if plan is None:
+            return []
+        disc = shlex.quote(pc.ps.get("discipline", "adag"))
+        spec = shlex.quote(pc.tree_spec())
+        cmds = []
+        for node in plan:
+            base = (f"--discipline {disc} --tree-spec {spec} "
+                    f"--tree-level {node.level} --tree-group {node.group} "
+                    f"--upstream {shlex.quote(node.upstream)}")
+            if pc.ps.get("lease") is not None:
+                base += f" --lease {float(pc.ps['lease'])}"
+            if pc.ps.get("tree_buffer") is not None:
+                base += f" --tree-buffer {int(pc.ps['tree_buffer'])}"
+            if pc.ps.get("snapshot_every") is not None:
+                base += f" --snapshot-every {int(pc.ps['snapshot_every'])}"
+            label = f"tree-L{node.level}-g{node.group}"
+            state = pc.ps.get("state_dir")
+            cmd = (f"python -m distkeras_tpu.netps --host 0.0.0.0 "
+                   f"--port {node.port} {base}")
+            if state:
+                cmd += f" --state-dir {shlex.quote(f'{state}/{label}')}"
+            cmds.append(cmd)
+            if node.standby_host is not None:
+                cmd = (f"python -m distkeras_tpu.netps --host 0.0.0.0 "
+                       f"--port {node.standby_port} "
+                       f"--standby {shlex.quote(node.endpoint)} {base}")
+                if state:
+                    cmd += (" --state-dir "
+                            f"{shlex.quote(f'{state}/{label}.standby')}")
+                cmds.append(cmd)
+        return cmds
+
     def _labels(self) -> dict:
         """Attribution fields for supervision telemetry events: the
         punchcard's job name plus, when set, the tenant it bills to — the
@@ -413,6 +505,19 @@ class Job:
             if standby_cmd is not None and self._standby_proc is None:
                 self._standby_proc = self._spawn_cmd(
                     pc.ps["standby_host"], standby_cmd)
+        if pc.tree_spec() and not self._tree_procs:
+            # Interior tree gang, top level first (render order is bottom
+            # level first): a node's parent must be listening before the
+            # node's ctor dials it. Standby lines dial their primary
+            # lazily, so interleaved order is fine for them.
+            plan = pc.tree_plan()
+            tree_cmds = self.render_tree_commands()
+            hosts = [h for node in plan
+                     for h in ([node.host] + ([node.standby_host]
+                                              if node.standby_host else []))]
+            self._tree_procs = [self._spawn_cmd(h, c)
+                                for h, c in reversed(list(zip(hosts,
+                                                              tree_cmds)))]
         self._cmds = cmds
         self.restarts = [0] * len(cmds)
         for i in range(len(cmds)):
@@ -445,7 +550,8 @@ class Job:
         """Every PS-plane process handle this job holds — the unsharded
         pair plus the shard gang (Nones included; callers skip them)."""
         return ([self._ps_proc, self._standby_proc]
-                + list(self._shard_procs) + list(self._shard_standby_procs))
+                + list(self._shard_procs) + list(self._shard_standby_procs)
+                + list(self._tree_procs))
 
     def _stop_ps(self, grace: float = 5.0) -> None:
         """Drain the parameter-server plane once the workers are done:

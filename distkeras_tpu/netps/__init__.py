@@ -59,6 +59,16 @@ production edges the reference never had:
   :class:`ShardedPSClient` fans pulls/commits out under ONE logical seq,
   plan-hash-validated at join and on every pull (mismatch = typed
   :class:`ShardPlanError`, never a silent mis-fold). docs/SHARDING.md;
+* :mod:`~distkeras_tpu.netps.tree` — N-level aggregation trees that
+  survive the WAN (``DKTPU_TREE_SPEC=host:8,pool:4,region:2``): every
+  interior :class:`TreeNode` is a first-class failure domain with its
+  own journal lineage, epoch fence, and region-local warm
+  :class:`TreeStandby` (promotes on lease lapse, re-parents the
+  children, joins the root itself); per-link capability-negotiated
+  codecs via the tuner's probe; partition ride-through — a black-holed
+  uplink buffers up to ``DKTPU_TREE_BUFFER`` windows and degrades past
+  the bound by counted, typed drops, never a silent divergence, never a
+  deadlock on a dead uplink. docs/RESILIENCE.md;
 * :mod:`~distkeras_tpu.netps.tuner` — the self-tuning data plane
   (``DKTPU_NET_AUTOTUNE=1``): join-time codec micro-probes over the
   negotiated connection plus an online controller that re-reads the live
@@ -106,10 +116,18 @@ from distkeras_tpu.netps.shards import (  # noqa: F401
     make_ps_client,
 )
 from distkeras_tpu.netps.standby import StandbyServer  # noqa: F401
+from distkeras_tpu.netps.tree import (  # noqa: F401
+    TreeDeployment,
+    TreeNode,
+    TreeSpec,
+    TreeStandby,
+    build_tree,
+)
 
 __all__ = [
     "PSServer", "serve", "PSClient", "CommitResult", "ChaosProxy",
     "AggregatorServer", "StandbyServer",
+    "TreeSpec", "TreeNode", "TreeStandby", "TreeDeployment", "build_tree",
     "PartitionPlan", "ShardedPSClient", "ShardSet", "make_ps_client",
     "NetPSError", "ProtocolError", "RPCTimeoutError", "ServerDrainingError",
     "LeaseExpiredError", "ServerClosedError", "EpochFencedError",

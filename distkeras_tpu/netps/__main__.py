@@ -16,6 +16,16 @@ that primary instead: it tails the journal stream, serves nothing until
 the primary's lease lapses, then promotes (printing ``NETPS_PROMOTED
 epoch=N``) and fences the old lineage.
 
+With ``--upstream host:port`` the process runs as an interior
+aggregation-tree node (``TreeNode``) instead: it absorbs its children's
+commits, journals them in absorb order, and flushes combined windows
+into the upstream — ``--tree-level``/``--tree-group`` locate it in the
+``DKTPU_TREE_SPEC`` shape (and key its uplink for ``link_down`` chaos),
+``--tree-buffer`` bounds partition ride-through. ``--upstream`` plus
+``--standby`` runs the node's region-local warm ``TreeStandby``, which
+on promotion fences the dead node AND joins the upstream itself so the
+subtree keeps flowing.
+
 It prints ``NETPS_READY <host:port>`` once listening and runs until
 SIGTERM/SIGINT, then drains gracefully (in-flight commits finish, late
 clients get a typed ``ServerDrainingError``). The FIRST signal prints
@@ -71,6 +81,29 @@ def main(argv=None) -> int:
                          "the partition plan is adopted from the first "
                          "join (and persisted under --state-dir). Applies "
                          "to primaries and standbys alike.")
+    ap.add_argument("--upstream", metavar="HOST:PORT[,...]", default=None,
+                    help="run as an interior aggregation-tree node that "
+                         "absorbs its children's commits and flushes "
+                         "combined windows into this upstream (comma list "
+                         "= failover walk). With --standby, run as that "
+                         "tree node's warm TreeStandby instead.")
+    ap.add_argument("--tree-level", type=int, default=0,
+                    help="this node's level in DKTPU_TREE_SPEC / "
+                         "--tree-spec (0 = leaf-most interior level)")
+    ap.add_argument("--tree-group", type=int, default=0,
+                    help="this node's group index within its level")
+    ap.add_argument("--tree-spec", default=None,
+                    help="bottom-up tree grammar name:fanout[:codec],... "
+                         "(default DKTPU_TREE_SPEC)")
+    ap.add_argument("--tree-buffer", type=int, default=None,
+                    help="partition ride-through bound in combined "
+                         "windows (default DKTPU_TREE_BUFFER)")
+    ap.add_argument("--fan-in", type=int, default=None,
+                    help="tree node flush fan-in (default: full local "
+                         "membership)")
+    ap.add_argument("--flush-interval", type=float, default=None,
+                    help="tree node max window age (seconds) before an "
+                         "undersized window flushes anyway")
     args = ap.parse_args(argv)
     shard_index = shard_count = None
     if args.shard:
@@ -85,14 +118,25 @@ def main(argv=None) -> int:
                  else config.env_str("DKTPU_PS_STATE_DIR") or None)
     standby_of = (args.standby if args.standby is not None
                   else config.env_str("DKTPU_PS_STANDBY") or None)
+    tree_spec = (args.tree_spec if args.tree_spec is not None
+                 else config.env_str("DKTPU_TREE_SPEC") or None)
+    if args.upstream and shard_index is not None:
+        ap.error("--shard and --upstream are mutually exclusive: an "
+                 "interior tree node is never itself a shard (shard the "
+                 "ROOT and point --upstream at the `;` matrix instead)")
     kw = dict(discipline=args.discipline, host=args.host, port=args.port,
               lease_s=args.lease, state_dir=state_dir,
-              snapshot_every=args.snapshot_every,
-              shard_index=shard_index, shard_count=shard_count)
+              snapshot_every=args.snapshot_every)
+    if not args.upstream:
+        kw.update(shard_index=shard_index, shard_count=shard_count)
     # Label this process for the trace/flight streams (an explicit
     # DKTPU_TRACE_ROLE — e.g. one the fleet launcher stamped — wins) and
     # arm the crash-path flight-recorder dump before anything can fail.
-    if standby_of:
+    if args.upstream and standby_of:
+        tracing.set_role(f"tree{args.tree_level}g{args.tree_group}-standby")
+    elif args.upstream:
+        tracing.set_role(f"tree{args.tree_level}g{args.tree_group}")
+    elif standby_of:
         tracing.set_role("standby")
     elif shard_index is not None:
         tracing.set_role(f"shard{shard_index}")
@@ -102,7 +146,22 @@ def main(argv=None) -> int:
     from distkeras_tpu.telemetry.vitals import start_vitals
 
     start_vitals()  # no-op unless DKTPU_VITALS_S is set
-    if standby_of:
+    tree_kw = dict(level=args.tree_level, group=args.tree_group,
+                   spec=tree_spec, buffer_windows=args.tree_buffer,
+                   fan_in=args.fan_in)
+    if args.flush_interval is not None:
+        tree_kw["flush_interval"] = args.flush_interval
+    if args.upstream and standby_of:
+        from distkeras_tpu.netps.tree import TreeStandby
+
+        server = TreeStandby(standby_of, upstream=args.upstream,
+                             promote_after=args.promote_after,
+                             **tree_kw, **kw).start()
+    elif args.upstream:
+        from distkeras_tpu.netps.tree import TreeNode
+
+        server = TreeNode(args.upstream, **tree_kw, **kw).start()
+    elif standby_of:
         from distkeras_tpu.netps.standby import StandbyServer
 
         server = StandbyServer(standby_of,

@@ -84,11 +84,18 @@ class AggregatorServer(PSServer):
                  fan_in: Optional[int] = None,
                  timeout: Optional[float] = None,
                  retries: Optional[int] = None,
-                 backoff: Optional[float] = None):
+                 backoff: Optional[float] = None,
+                 state_dir: Optional[str] = None,
+                 snapshot_every: Optional[int] = None,
+                 epoch: int = 0):
         # Validate BEFORE the upstream join (a bad discipline/transport
         # must not leak a phantom root membership); the PSClient ctor
         # validates the transport.
         check_discipline(discipline)
+        # Before super().__init__: a fresh state dir snapshots from the
+        # PSServer ctor, and this class's snapshot override reads the
+        # absorb cursor.
+        self._absorbs = 0
         # The factory: a sharded root (``;`` endpoint matrix) gets a
         # ShardedPSClient — the aggregator is then the ONE sharding-aware
         # hop on this host, and its local workers stay plain.
@@ -99,7 +106,8 @@ class AggregatorServer(PSServer):
             updates = _counter_scalar(updates)
             super().__init__(center=center, discipline=discipline,
                              host=host, port=port, lease_s=lease_s,
-                             transport=transport)
+                             transport=transport, state_dir=state_dir,
+                             snapshot_every=snapshot_every, epoch=epoch)
         except BaseException:
             try:
                 self._up.leave()
@@ -107,10 +115,35 @@ class AggregatorServer(PSServer):
                 pass
             self._up.close()
             raise
+        if state_dir:
+            # The recovered update counter IS the absorb cursor: the
+            # aggregator journals/replicates per absorbed window (the
+            # root-lineage counter only advances on re-pull, so it cannot
+            # index the journal). Resume the cursor from the journal...
+            self._absorbs = int(self._updates)
+            # PSServer recovery adopted the DISK center + counter — right
+            # for a root, wrong here: an aggregator's center is the root's
+            # (just re-pulled via the join above) and its counter is in
+            # root units. Keep recovery's dedup table/epoch/commits_total
+            # (a restarted aggregator must still dedup its children's
+            # retransmits) and restore the upstream view.
+            self._center = [np.array(a, np.float32) for a in center]
         self._updates = int(updates)  # root-lineage counter, not local
         self.upstream = upstream
         self.flush_interval = float(flush_interval)
         self.fan_in = fan_in
+        self._init_absorb_state()
+        self._flush_cv = threading.Condition(self._lock)
+        self._flusher_thread: Optional[threading.Thread] = None
+
+    def _init_absorb_state(self) -> None:
+        """The combined-window accumulator + its accounting, factored out
+        so a tree node's warm standby (a :class:`~distkeras_tpu.netps.
+        server.PSServer` by construction, an aggregator only after it
+        promotes) can arm the same absorb machinery without this class's
+        ctor (which dials upstream eagerly)."""
+        if not hasattr(self, "_absorbs"):
+            self._absorbs = 0
         #: accumulated (decoded f32) combined delta + its min pull counter.
         self._acc: Optional[list] = None
         self._acc_pulled: Optional[int] = None
@@ -123,14 +156,21 @@ class AggregatorServer(PSServer):
         #: the flush's ``hier.flush`` span links them, so a worker's
         #: commit trace connects to the combined upstream commit's.
         self._acc_traces: list = []
+        #: constituent (wid, seq) identities of the open window — a lost
+        #: window's ``netps_lost_window`` event names exactly which
+        #: workers' commits died with it (bounded like the trace links).
+        self._acc_pairs: list = []
         self._acc_t0 = 0.0
-        self._flush_cv = threading.Condition(self._lock)
-        self._flusher_thread: Optional[threading.Thread] = None
         #: combined commits forwarded upstream / worker commits absorbed —
         #: forwarded/absorbed is the measured root-ingress cut.
         self.forwarded = 0
         self.absorbed = 0
+        #: worker windows inside forwarded combined commits (constituent
+        #: count, not combined count) — with lost/dropped/buffered these
+        #: make the window-conservation ledger the tree stats expose.
+        self.forwarded_commits = 0
         self.lost_windows = 0
+        self.lost_commits = 0
 
     # ------------------------------------------------------------------
     def start(self) -> "AggregatorServer":
@@ -198,10 +238,33 @@ class AggregatorServer(PSServer):
             self._acc_traces.append(ctx.trace)
         self._acc_count += 1
         self._acc_members.add(wid)
+        if len(self._acc_pairs) < 512:
+            self._acc_pairs.append((wid, seq))
         self.absorbed += 1
         self.commit_log.append((wid, seq, staleness))
         self._last_seq[wid] = seq
         self.commits_total += 1
+        # Durability tail, absorb-order = journal order (the root folds
+        # against the update counter; an aggregator journals/replicates
+        # against its absorb cursor — see ``_absorbs``). A storeless,
+        # standby-less aggregator pays nothing here.
+        u = self._absorbs
+        self._absorbs += 1
+        if self._repl_on:
+            rec = {"u": u, "wid": wid, "seq": seq, "st": staleness,
+                   "e": self.epoch, "n": self.commits_total,
+                   "delta": list(delta)}
+            if ctx is not None:
+                rec["tr"] = ctx.trace
+            self._repl.append(rec)
+        if self._store is not None:
+            with tracing.child_scope("commit.fsync", wid=wid, seq=seq):
+                self._store.append(epoch=self.epoch, wid=wid, seq=seq,
+                                   staleness=staleness, updates=u,
+                                   commits_total=self.commits_total,
+                                   delta=delta)
+                if self._store.due(self._absorbs):
+                    self._snapshot_locked()
         # The same month-long-run bound the root server keeps: the
         # aggregator's absorbed-commit evidence must not grow without
         # limit either (len + dropped == commits_total holds here too).
@@ -209,6 +272,22 @@ class AggregatorServer(PSServer):
         self._purge_pending(wid, below_seq=seq)
         self._flush_cv.notify_all()
         return staleness
+
+    def _repl_cursor_locked(self) -> int:
+        # Replication (and with it a warm standby's tail) advances by the
+        # absorb cursor, not the root-lineage update counter.
+        return self._absorbs
+
+    def _snapshot_locked(self) -> None:
+        # The snapshot cursor must line up with the journal's ``u``
+        # fields — the absorb cursor, not the root-lineage counter. The
+        # center snapshotted is the adopted root center: a restarted
+        # aggregator's recovery base until it re-pulls upstream.
+        self._store.snapshot(center=self._center, updates=self._absorbs,
+                             last_seq=self._last_seq, epoch=self.epoch,
+                             commits_total=self.commits_total)
+        self.snapshots_written += 1
+        self._trim_log_locked(self._log_keep + 1)
 
     # ------------------------------------------------------------------
     def _take_acc_locked(self, force: bool):
@@ -220,19 +299,27 @@ class AggregatorServer(PSServer):
                 and age < self.flush_interval):
             return None
         taken = (self._acc, self._acc_pulled, self._acc_count,
-                 len(self._acc_members), self._acc_traces)
+                 len(self._acc_members), self._acc_traces, self._acc_pairs)
         self._acc = None
         self._acc_pulled = None
         self._acc_count = 0
         self._acc_members = set()
         self._acc_traces = []
+        self._acc_pairs = []
         return taken
 
-    def _lose_window(self) -> None:
+    def _lose_window(self, pairs: Sequence = (), count: int = 1) -> None:
+        """One combined window died (in flight, or landed evicted): count
+        it AND name its constituents — the flight recorder must show which
+        workers' (wid, seq) windows died, not just that one did."""
         from distkeras_tpu import telemetry
 
         self.lost_windows += 1
+        self.lost_commits += int(count)
         telemetry.counter("netps.hier.lost_windows").add(1)
+        telemetry.event("netps_lost_window", {
+            "count": int(count),
+            "windows": [[int(w), int(s)] for w, s in pairs]})
 
     def _flush_once(self, force: bool) -> bool:
         """Forward the accumulated combined commit upstream (outside the
@@ -248,7 +335,7 @@ class AggregatorServer(PSServer):
             taken = self._take_acc_locked(force)
         if taken is None:
             return False
-        acc, pulled, count, members, traces = taken
+        acc, pulled, count, members, traces, pairs = taken
         try:
             # The combined commit gets its own trace, LINKING the
             # constituent worker traces (a fan-in is a DAG, not a tree —
@@ -260,15 +347,16 @@ class AggregatorServer(PSServer):
             # Past the client's own retry budget: the combined window died
             # in flight — the flat topology's lost-commit semantics, one
             # level up.
-            self._lose_window()
+            self._lose_window(pairs, count)
             return True
         if res.evicted:
             # The aggregator's root lease lapsed with this window pending:
             # the combined commit was discarded upstream. The client
             # already re-joined; fall through to re-adopt.
-            self._lose_window()
+            self._lose_window(pairs, count)
         else:
             self.forwarded += 1
+            self.forwarded_commits += count
             telemetry.counter("netps.hier.combined_commits").add(1)
             telemetry.counter("netps.hier.worker_commits").add(count)
             # Distinct contributors, not commit count — an overlapping

@@ -769,7 +769,7 @@ class PSServer:
         # clients on the f32 single-connection dialect). A server actually
         # serving a ring replaces the static ``shm`` bit with its doorbell
         # endpoint + boot id — the client upgrades only on a boot-id match.
-        caps = dict(wire.CAPS)
+        caps = self._caps()
         if self._uds_path is not None and "shm" in caps:
             caps["shm"] = {"boot_id": self._boot_id, "uds": self._uds_path}
         if sharding is not None:
@@ -1117,6 +1117,21 @@ class PSServer:
                  "snapshot": telemetry.get().snapshot(),
                  "ring": ring, **extra}, [])
 
+    def _caps(self) -> dict:
+        """The static capability set a join reply starts from. An
+        aggregation-tree node overrides this to replace the ``tree`` bit
+        with its level/group identity (the same replace-the-static-bit
+        pattern the shm and sharding upgrades use below)."""
+        return dict(wire.CAPS)
+
+    def _repl_cursor_locked(self) -> int:
+        """The fold index replication advances by (lock held): the center
+        update counter here. An aggregation-tree node overrides this with
+        its absorb cursor — its counter mirrors the ROOT lineage and only
+        moves on re-pull, so it cannot index the journal its standby
+        tails."""
+        return self._updates
+
     def _op_replicate(self, header: dict) -> tuple[dict, list]:
         """One pull of the journal stream by a warm standby: ``u`` is the
         next fold index the standby needs. Answers a batch of journal
@@ -1135,10 +1150,11 @@ class PSServer:
             # First replicate turns the tail buffer on; until a standby
             # exists no deployment pays its memory.
             self._repl_on = True
+            cursor = self._repl_cursor_locked()
             recs = [r for r in self._repl if r["u"] >= u]
-            if u == self._updates:
+            if u == cursor:
                 recs = []
-            elif u < 0 or u > self._updates or not recs or recs[0]["u"] != u:
+            elif u < 0 or u > cursor or not recs or recs[0]["u"] != u:
                 # Fresh standby / behind the tail / gap — or a standby
                 # AHEAD of this primary (a cold restart lost the journal
                 # tail the standby had already replicated): the primary's
@@ -1148,7 +1164,7 @@ class PSServer:
                 # never retransmit — the standard lost-window semantics,
                 # never a divergent fold.
                 hdr = {"ok": True, "mode": "snapshot",
-                       "updates": self._updates, "epoch": self.epoch,
+                       "updates": cursor, "epoch": self.epoch,
                        "lineage": self.lineage,
                        "commits_total": self.commits_total,
                        "last_seq": {str(k): int(v)
@@ -1167,7 +1183,7 @@ class PSServer:
             for r in recs:
                 out.extend(r["delta"])
             return ({"ok": True, "mode": "records", "records": headers,
-                     "updates": self._updates, "epoch": self.epoch,
+                     "updates": cursor, "epoch": self.epoch,
                      "lineage": self.lineage}, out)
 
     def _op_fence(self, header: dict) -> tuple[dict, list]:

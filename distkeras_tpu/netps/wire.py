@@ -122,9 +122,18 @@ CODECS = (CODEC_NONE, CODEC_BF16, CODEC_INT8)
 #: on the wire; the server likewise answers the clock fields only on
 #: requests that carried ``ct0``. JSON headers make the gate structural:
 #: an absent key is an absent byte.
+#: ``tree`` advertises the N-level aggregation-tree plane
+#: (``netps/tree.py``): an interior tree node replaces the static bit
+#: with its ``{"level", "group", "spec"}`` identity in every join reply —
+#: the same replace-the-static-bit pattern the shm and sharding upgrades
+#: use — so a child (a worker, or a lower-level aggregator) can tell which
+#: failure domain it just parented into, and its replicate replies carry
+#: the root-lineage counter (``root_u``) its warm standby seeds promotion
+#: from. A plain PSServer's ``True`` just says the build understands the
+#: tree dialect.
 CAPS = {"codecs": list(CODECS), "striping": True, "shm": True,
         "replication": True, "serving": True, "sharding": True,
-        "tuner": True, "tracing": True}
+        "tuner": True, "tracing": True, "tree": True}
 
 #: the core parameter-server ops (``header["op"]``). Every op constant in
 #: the package MUST be declared in :data:`OP_REGISTRY` below — dk-check's
@@ -176,12 +185,12 @@ OP_REGISTRY = {
     OP_LEAVE: OpSpec(None, ()),
     OP_REPLICATE: OpSpec("replication",
                          ("mode", "records", "updates", "epoch", "lineage",
-                          "commits_total", "last_seq")),
+                          "commits_total", "last_seq", "root_u")),
     OP_FENCE: OpSpec("replication", ("fenced", "epoch")),
     OP_INFER: OpSpec("serving", ("arrays", "error")),
     OP_STATS: OpSpec(None, ("caps", "role", "snapshot", "ring", "updates",
                             "epoch", "members", "commits_total", "draining",
-                            "ready")),
+                            "ready", "tree")),
     OP_PROBE: OpSpec("tuner", ("probe_bytes", "decode_s")),
 }
 
@@ -214,6 +223,8 @@ HEADER_KEYS = frozenset({
     # replication / failover
     "u", "mode", "records", "lineage", "commits_total", "fenced",
     "wid", "st", "e", "n", "k", "tr",
+    # aggregation tree (replicate's root-counter rider + the stats block)
+    "root_u", "tree",
     # sharded center
     "want_plan", "plan_hash", "sharding", "shard_index", "shard_plan",
     "plan", "index", "count",

@@ -105,14 +105,26 @@ _KINDS = frozenset({
 #: they share), and the arg is the commit threshold. Consumed by the shard
 #: server via the non-consuming :meth:`FaultPlan.pending` peek (shard
 #: k != N must not burn the one-shot), fired in the killed shard's own
-#: process.
+#: process. ``link_down@K:S`` black-holes ONE aggregation-tree uplink for
+#: S seconds: the ``at`` slot carries the link key
+#: ``TreeSpec.link_key(level, group) = level*1000 + group`` — the
+#: (level, group) uplink packed into the one integer the grammar allows —
+#: and is consumed by that tree node's own uplink transport
+#: (``netps/tree.py``), because no chaos proxy can sit on every interior
+#: hop. Commits keep flowing INTO the node; its flushes buffer (bounded by
+#: ``DKTPU_TREE_BUFFER``, then counted typed drops) and its upstream
+#: heartbeats stop, so the uplink lease genuinely lapses — the heal path
+#: must re-prove membership before draining. ``link_flap@K:S`` is the
+#: flappy variant: down S, up S, down S again — two outages from one
+#: entry, exercising the drain->re-black-hole path. Schedule both in the
+#: tree NODE's process environment.
 _NET_KINDS = frozenset({
     "delay", "drop", "dup", "truncate", "partition", "evict",
     "delay_r", "drop_r", "dup_r", "truncate_r",
     "shm_delay", "shm_corrupt",
     "ps_crash", "ps_hang", "preempt",
     "serve_slow", "serve_drop",
-    "shard_crash",
+    "shard_crash", "link_down", "link_flap",
 })
 
 

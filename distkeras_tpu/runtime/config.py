@@ -273,9 +273,32 @@ ENV_REGISTRY: dict = _declare(
            "ring; `ps_crash`/`ps_hang` hit the server process; `preempt` "
            "drives the FleetScheduler's forced-preemption drill; "
            "`serve_slow`/`serve_drop` hit the serving frontend's request "
-           "stream) "
+           "stream; `link_down`/`link_flap` black-hole one aggregation-tree "
+           "uplink, keyed by `TreeSpec.link_key(level, group)`) "
            "separated by `;`, e.g. `delay@3:0.2;drop@5;partition@7:2`. "
            "Empty = no injection. See docs/RESILIENCE.md.",
+           "network"),
+    EnvVar("DKTPU_TREE_SPEC", "str", "",
+           "Aggregation-tree shape, bottom-up: `name:fanout[:codec]` "
+           "levels separated by `,`, e.g. `host:8,pool:4,region:2` — "
+           "workers flush into level-0 nodes, each level folds `fanout` "
+           "children into one combined commit, the top level flushes into "
+           "the root PS. A level's optional codec pins its uplinks "
+           "(`region:2:int8`); otherwise each link probes its own. Empty "
+           "= flat star (or the single `DKTPU_NET_HIER` level).",
+           "network"),
+    EnvVar("DKTPU_TREE_BUFFER", "int", 32,
+           "Partition ride-through bound: combined windows a tree node "
+           "buffers while its uplink is black-holed. The buffer drains "
+           "in-order on heal (exactly-once end-to-end); past the bound "
+           "the OLDEST windows degrade to counted, typed drops "
+           "(`netps_tree_window_drop`) the staleness rule absorbs.",
+           "network"),
+    EnvVar("DKTPU_TREE_DEMOTE_AFTER", "int", 3,
+           "Consecutive uplink transport failures before a tree node "
+           "demotes that one link to plain TCP (per-link shm->TCP "
+           "fallback, dedup-preserving redial); a healthy streak "
+           "renegotiates back up. 0 disables auto-demotion.",
            "network"),
     EnvVar("DKTPU_PS_LEASE", "float", 10.0,
            "Membership lease (seconds) the netps server grants on join and "

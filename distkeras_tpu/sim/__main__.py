@@ -11,6 +11,11 @@ Subcommands::
         The flat->hier crossover replay against the bench summary's
         ``hier_curve``: held-out predictions must land within the band
         and the predicted crossover must match the measured one.
+        ``--tree-live live.json`` runs the aggregation-tree gate
+        instead: re-fit ``region_partition`` from a recorded live tree
+        run and assert the root ingress cut and partition staleness
+        spike agree within the band (the ``tree_parity`` block the tree
+        chaos smoke writes into BENCH_SUMMARY.json).
 
     report --trace-dir DIR [--json]
         Fit the timing model from a trace stream and print it (the same
@@ -50,8 +55,25 @@ def _cmd_run(args: argparse.Namespace) -> int:
 
 
 def _cmd_calibrate(args: argparse.Namespace) -> int:
-    from distkeras_tpu.sim.calibrate import hier_crossover
+    from distkeras_tpu.sim.calibrate import hier_crossover, tree_parity
 
+    if args.tree_live:
+        with open(args.tree_live, "r", encoding="utf-8") as f:
+            live = json.load(f)
+        out = tree_parity(live, band_pct=args.band, seed=args.seed or 0)
+        if args.json:
+            print(json.dumps(out, indent=2, sort_keys=True))
+        else:
+            print(f"tree parity: ingress cut live="
+                  f"{out['live']['ingress_cut']} sim="
+                  f"{out['sim']['ingress_cut']} "
+                  f"(ratio {out['ingress_cut_ratio']})  staleness spike "
+                  f"live={out['live']['staleness_spike']} sim="
+                  f"{out['sim']['staleness_spike']} "
+                  f"(ratio {out['staleness_spike_ratio']})  band "
+                  f"{out['band_pct']:.0f}%")
+        print("OK" if out["within_band"] else "FAILED")
+        return 0 if out["within_band"] else 1
     out = hier_crossover(summary=args.summary, band_pct=args.band,
                          seed=args.seed or 0)
     if args.json:
@@ -119,6 +141,9 @@ def main(argv=None) -> int:
     calp.add_argument("--band", type=float, default=None,
                       help="tolerance pct (default DKTPU_SIM_BAND_PCT)")
     calp.add_argument("--seed", type=int, default=None)
+    calp.add_argument("--tree-live", default=None, metavar="PATH",
+                      help="recorded live-tree run (JSON dict): run the "
+                           "tree_parity gate instead of the hier replay")
     calp.add_argument("--json", action="store_true")
 
     repp = sub.add_parser("report", help="fitted timing model from traces")

@@ -1,6 +1,6 @@
 """Calibration gates: replaying measured deployments through the sim.
 
-Two replays keep the simulator honest:
+Three replays keep the simulator honest:
 
 * :func:`predict_throughput` / :func:`sim_drift` — replay a *traced*
   loopback deployment (bench config #8's data plane): fit the timing
@@ -29,6 +29,15 @@ Two replays keep the simulator honest:
   throughput ratio crosses the flip threshold at the measured crossover
   (W=4, matching ``recommended_topology``'s ``DKTPU_TUNE_HIER_FANIN``
   default) with a root-ingress cut that justifies the topology.
+
+* :func:`tree_parity` — re-fit the ``region_partition`` scenario from a
+  LIVE traced aggregation-tree run (its fanouts, flush cadence, commit
+  period, and partition window) and assert the sim reproduces the root
+  ingress cut and the partitioned region's staleness spike within the
+  band — the gate that licenses the tree what-ifs at 1000-worker scale.
+  The tree chaos smoke publishes it as the ``tree_parity`` block in
+  BENCH_SUMMARY.json; ``sim calibrate --tree-live live.json`` replays
+  one from a recorded live dict.
 """
 
 from __future__ import annotations
@@ -147,6 +156,93 @@ def sim_drift(records: list, measured_tokens_per_sec: float,
                         if ratio is not None else None),
         "workers": pred["workers"], "rounds": pred["rounds"],
         "sim_commits": pred["commits"],
+    }
+
+
+# -- the live-tree region-partition replay ----------------------------------
+
+def tree_parity(live: dict, band_pct: Optional[float] = None,
+                seed: int = 0) -> dict:
+    """The aggregation-tree calibration gate: re-fit the
+    ``region_partition`` scenario from a LIVE traced tree run and assert
+    the sim reproduces the two load-bearing shapes — the root ingress
+    cut (absorbed worker commits per root fold) and the partitioned
+    region's staleness spike — within the band.
+
+    ``live`` is the measured run: ``workers``, ``fanouts`` (bottom-up
+    interior fanouts, e.g. ``[2]`` for a 2-region/one-tier tree),
+    ``rounds`` per worker, ``work_s`` (the fitted mean per-worker commit
+    period — wall / rounds), ``flush_s`` (the tree nodes' flush
+    interval), ``partition`` ``(t0, t1)`` in run-relative seconds, and
+    the two measured shapes: ``ingress_cut`` (total absorbed / total
+    root folds from the tree) and ``staleness_spike`` (the partitioned
+    region's MAX root-fold staleness — both systems pin it to partition
+    duration x healthy root update rate, so it transfers; the
+    partitioned/healthy RATIO would instead ride the noisy tail question
+    of whether some healthy flush happens to interleave the heal drain).
+    The spike comparison is +1-regularized so a zero-staleness run still
+    ratios. Optional: ``link_latency_s`` (default 1 ms), ``codec``
+    (uplink codec class, default ``none``).
+
+    Both systems run the SAME structure — fan-in-or-age windows, frozen
+    pull counters under the partition, in-order heal drain — so
+    agreement here is what licenses the 1000-worker what-ifs: the
+    ``region_partition`` defaults extrapolate exactly the machinery
+    this gate pinned to a live trace."""
+    from distkeras_tpu.sim.cluster import LinkClass
+    from distkeras_tpu.sim.scenarios import region_partition
+
+    band = _band_pct(band_pct)
+    fanouts = [int(f) for f in live["fanouts"]]
+    lat = float(live.get("link_latency_s", 0.001))
+    codec = str(live.get("codec", "none"))
+    levels = []
+    for i, fan in enumerate(fanouts):
+        top = i == len(fanouts) - 1
+        name = "region" if top else f"tier{i}"
+        levels.append((name, fan,
+                       LinkClass(name, lat, jitter=0.10,
+                                 codec=codec if top else "none")))
+    workers, rounds = int(live["workers"]), int(live["rounds"])
+    sim = region_partition(workers=workers, seed=seed, rounds=rounds,
+                           work_s=float(live["work_s"]),
+                           partition=tuple(live["partition"]),
+                           levels=levels,
+                           flush_s=float(live["flush_s"]))
+    sim_cut = (workers * rounds) / max(1, int(sim["root_commits"]))
+    stale = {int(g): int(s)
+             for g, s in sim["staleness_by_region"].items()}
+    part = int(sim["partitioned_region"])
+    sim_spike = float(stale.get(part, 0))
+    live_cut = float(live["ingress_cut"])
+    live_spike = float(live["staleness_spike"])
+    cut_ratio = (sim_cut / live_cut) if live_cut else None
+    spike_ratio = (sim_spike + 1.0) / (live_spike + 1.0)
+
+    def _in_band(ratio: Optional[float]) -> bool:
+        return ratio is not None and abs(ratio - 1.0) <= band / 100.0
+
+    return {
+        "metric": "sim_tree_vs_live_region_partition",
+        "band_pct": band, "seed": seed,
+        "live": {"workers": workers, "rounds": rounds,
+                 "fanouts": fanouts,
+                 "work_s": round(float(live["work_s"]), 4),
+                 "flush_s": round(float(live["flush_s"]), 4),
+                 "partition": [round(float(t), 3)
+                               for t in live["partition"]],
+                 "ingress_cut": round(live_cut, 3),
+                 "staleness_spike": round(live_spike, 3)},
+        "sim": {"ingress_cut": round(sim_cut, 3),
+                "staleness_spike": round(sim_spike, 3),
+                "root_commits": int(sim["root_commits"]),
+                "checks_ok": bool(sim["ok"])},
+        "ingress_cut_ratio": (round(cut_ratio, 4)
+                              if cut_ratio is not None else None),
+        "staleness_spike_ratio": (round(spike_ratio, 4)
+                                  if spike_ratio is not None else None),
+        "within_band": (_in_band(cut_ratio) and _in_band(spike_ratio)
+                        and bool(sim["ok"])),
     }
 
 

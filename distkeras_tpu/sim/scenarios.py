@@ -297,7 +297,8 @@ def failover_cascade(workers: int = 120, seed: Optional[int] = None,
 
 def region_partition(workers: int = 960, seed: Optional[int] = None,
                      rounds: int = 40, work_s: float = 0.2,
-                     partition=(3.0, 6.0)) -> dict:
+                     partition=(3.0, 6.0), levels=None,
+                     flush_s: float = 0.05) -> dict:
     """An N-level aggregation tree (host -> pool -> region, per-link
     codec/latency classes) with one region's uplink black-holed for a
     window. During the partition that region's workers run on a cached
@@ -308,16 +309,25 @@ def region_partition(workers: int = 960, seed: Optional[int] = None,
     Invariants: value conservation at the root (every worker commit
     accounted, none double-folded), exactly-once, and the partitioned
     region's staleness spiking above the healthy regions'.
+
+    ``levels``/``flush_s`` re-shape the tree without forking the
+    scenario: :func:`~distkeras_tpu.sim.calibrate.tree_parity` re-fits
+    this scenario to a LIVE traced tree's shape (its fanouts, flush
+    cadence, and measured commit period) and asserts agreement. The
+    defaults are the 1000-worker what-if unchanged.
     """
     engine = SimEngine(seed)
     center = SimCenter(discipline="downpour")
-    levels = [
-        ("host", 8, LinkClass("host", 0.0002, jitter=0.10, codec="int8")),
-        ("pool", 4, LinkClass("pool", 0.001, jitter=0.10, codec="bf16")),
-        ("region", 10, LinkClass("region", 0.005, jitter=0.10,
-                                 codec="none")),
-    ]
-    topo = TreeTopology(workers, levels, flush_s=0.05)
+    if levels is None:
+        levels = [
+            ("host", 8,
+             LinkClass("host", 0.0002, jitter=0.10, codec="int8")),
+            ("pool", 4, LinkClass("pool", 0.001, jitter=0.10,
+                                  codec="bf16")),
+            ("region", 10, LinkClass("region", 0.005, jitter=0.10,
+                                     codec="none")),
+        ]
+    topo = TreeTopology(workers, levels, flush_s=flush_s)
     region_level = len(levels) - 1
     regions = len(topo.aggregators[region_level])
     part_region = 1 if regions > 1 else 0

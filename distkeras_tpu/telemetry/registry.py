@@ -210,6 +210,24 @@ _METRICS = [
        "Combined commits forwarded upstream (ratio = ingress cut)."),
     _m("netps.hier.lost_windows", "counter", "netps",
        "Combined windows lost to an upstream eviction."),
+    _m("netps.tree.buffered_windows", "gauge", "netps",
+       "Combined windows riding out a dark uplink in a tree node."),
+    _m("netps.tree.drained_windows", "counter", "netps",
+       "Buffered windows drained in-order after an uplink heal."),
+    _m("netps.tree.dropped_windows", "counter", "netps",
+       "Windows dropped (typed) past the tree ride-through bound."),
+    _m("netps.tree.dropped_commits", "counter", "netps",
+       "Constituent worker commits inside dropped tree windows."),
+    _m("netps.tree.silent_loss", "gauge", "netps",
+       "Tree window-conservation residual; nonzero = a silent loss."),
+    _m("netps.tree.link_downs", "counter", "netps",
+       "Injected link_down/link_flap outages consumed by tree uplinks."),
+    _m("netps.tree.link_demotions", "counter", "netps",
+       "Tree uplinks demoted to plain TCP after failure streaks."),
+    _m("netps.tree.link_promotions", "counter", "netps",
+       "Demoted tree uplinks renegotiated back up."),
+    _m("netps.tree.codec_negotiations", "counter", "netps",
+       "Per-link codec picks (pinned, probed, or default)."),
     _m("netps.recovery.snapshots", "gauge", "netps",
        "Snapshots written by the live server."),
     _m("netps.recovery.snapshot_loads", "counter", "netps",

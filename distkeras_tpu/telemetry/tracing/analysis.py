@@ -57,7 +57,9 @@ ROOT_NAMES = frozenset({"commit", "pull", "serve.request", "hier.flush",
 #: event kinds correlated against the slow tail.
 CHAOS_KINDS = frozenset({"fault_injected", "flight_dump", "netps_eviction",
                          "netps_rejoin", "netps_promotion",
-                         "netps_fenced", "serving_revocation"})
+                         "netps_fenced", "serving_revocation",
+                         "netps_lost_window", "netps_tree_window_drop",
+                         "netps_tree_link_down"})
 #: alignment slack (seconds) before a child-before-root timestamp counts
 #: as a clock violation — min-RTT offset estimates are good to ~rtt/2.
 SKEW_SLACK_S = 0.005

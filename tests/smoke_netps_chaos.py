@@ -52,6 +52,27 @@ shard's standby must have promoted past epoch 0::
     NETPS_SMOKE_SHARDS=2 DKTPU_PS_STATE_DIR=/tmp/ps-state \\
         python tests/smoke_netps_chaos.py          # sharded failover path
 
+**Region-partition tree mode** (``NETPS_SMOKE_TREE=1`` + state dir): a
+2-region, 3-level aggregation tree (workers -> region ``TreeNode``
+subprocesses -> root subprocess). Region 0's aggregator SIGKILLs itself
+mid-run (``ps_crash`` in its own plan); its warm region-local
+``TreeStandby`` promotes, fences the epoch, and the trainer's workers
+re-parent via their ordinary endpoint walk. Region 1's UPLINK is
+black-holed (``link_down@<link_key>``) past its deliberately tiny
+ride-through buffer, so degradation must be counted and typed (the
+``dropped_*`` ledger columns; ``silent_loss`` stays 0). Exactly-once is
+asserted on EVERY journal (root, both region lineages), epochs must be
+nondecreasing, and the run must still converge. A second, in-process
+traced loopback tree then replays the partition and gates on simulator
+parity: ``sim.calibrate.tree_parity`` re-fits the PR 16
+``region_partition`` scenario to the live run's shape and the root
+ingress cut + partition staleness spike must agree within
+``DKTPU_SIM_BAND_PCT`` — the ``tree_parity`` block written into
+``BENCH_SUMMARY.json``::
+
+    NETPS_SMOKE_TREE=1 DKTPU_PS_STATE_DIR=/tmp/ps-state \\
+        python tests/smoke_netps_chaos.py          # region-partition path
+
 All seeds are pinned (data rng, trainer seed, fault-plan seeds, the
 ``ps_crash``/``shard_crash`` commit indices), so reruns schedule the
 same chaos.
@@ -411,6 +432,287 @@ def _run_sharded(df, model) -> int:
     return 0
 
 
+def _scrape_tree_stats(endpoint) -> dict:
+    """One membership-free ledger scrape of a tree node subprocess."""
+    from distkeras_tpu.netps import PSClient
+
+    c = PSClient(endpoint, timeout=1.0, retries=5, backoff=0.1)
+    try:
+        return c.stats().get("tree") or {}
+    finally:
+        c.close()
+
+
+def _run_tree_parity(repo_summary) -> dict:
+    """Phase 2 of the tree drill: a live in-process loopback tree under a
+    pinned mid-run partition, re-fitted through the simulator. The sim's
+    ``region_partition`` scenario — re-shaped to THIS tree — must
+    reproduce the measured root ingress cut and the partitioned region's
+    staleness spike within the calibration band; the verdict lands in
+    ``BENCH_SUMMARY.json`` under ``tree_parity``."""
+    import json
+    import time
+
+    from distkeras_tpu.netps import PSClient, PSServer
+    from distkeras_tpu.netps.tree import TreeSpec, build_tree
+    from distkeras_tpu.resilience import faults
+    from distkeras_tpu.sim.calibrate import tree_parity
+
+    workers, rounds, work_s, part_s = 4, 30, 0.05, 1.0
+    root = PSServer(discipline="adag",
+                    center=[np.zeros(4, np.float32)], lease_s=30.0).start()
+    tree = None
+    clients = []
+    try:
+        tree = build_tree("region:2", root.endpoint, workers=workers,
+                          buffer_windows=256, flush_interval=0.05,
+                          probe_links=False)
+        clients = [PSClient(tree.leaf_endpoint(r)) for r in range(workers)]
+        for c in clients:
+            c.join(init=[np.zeros(4, np.float32)])
+        key = TreeSpec.link_key(0, 1)
+        t0 = time.monotonic()
+        part_t0 = None
+        for rnd in range(rounds):
+            if rnd == rounds // 3 and part_t0 is None:
+                faults.set_net_plan(faults.FaultPlan.parse_net(
+                    f"link_down@{key}:{part_s}"))
+                part_t0 = time.monotonic() - t0
+            for c in clients:
+                _, pulled = c.pull()
+                c.commit([np.ones(4, np.float32) * 0.001], pulled)
+            time.sleep(work_s)
+        wall = time.monotonic() - t0
+        deadline = time.monotonic() + part_s + 5.0
+        while time.monotonic() < deadline:  # heal + drain
+            s1 = tree.node(0, 1).tree_stats()
+            if s1["buffered_windows"] == 0 and not s1["link_down"]:
+                break
+            time.sleep(0.1)
+        time.sleep(0.3)
+        n0, n1 = tree.node(0, 0), tree.node(0, 1)
+        s0, s1 = n0.tree_stats(), n1.tree_stats()
+        assert s0["silent_loss"] == 0 and s1["silent_loss"] == 0, (
+            "the traced loopback tree lost a window silently")
+        assert s1["buffered_windows"] == 0, (
+            "region 1 never drained its ride-through buffer after heal")
+        absorbed = s0["absorbed"] + s1["absorbed"]
+        part_stale = max(
+            (st for wid, _seq, st in root.commit_log
+             if wid == n1._up.worker_id), default=0)
+        live = {
+            "workers": workers, "fanouts": [2], "rounds": rounds,
+            "work_s": wall / rounds, "flush_s": 0.05,
+            "partition": [part_t0, part_t0 + part_s],
+            "ingress_cut": absorbed / max(1, root.commits_total),
+            "staleness_spike": int(part_stale),
+        }
+    finally:
+        faults.reset()
+        for c in clients:
+            try:
+                c.leave()
+            except Exception:  # noqa: BLE001 - teardown best effort
+                pass
+            c.close()
+        if tree is not None:
+            tree.close()
+        root.close()
+    parity = tree_parity(live, band_pct=None)
+    print(f"netps tree parity: ingress cut live="
+          f"{parity['live']['ingress_cut']:.3f} sim="
+          f"{parity['sim']['ingress_cut']:.3f} "
+          f"(ratio {parity['ingress_cut_ratio']:.3f})  staleness spike "
+          f"live={parity['live']['staleness_spike']} sim="
+          f"{parity['sim']['staleness_spike']} "
+          f"(ratio {parity['staleness_spike_ratio']:.3f})  band "
+          f"{parity['band_pct']:.0f}%")
+    assert parity["within_band"], (
+        "the simulator's region_partition replay left the calibration "
+        f"band: {json.dumps(parity, sort_keys=True)}")
+    summary_path = os.environ.get("NETPS_SMOKE_SUMMARY", repo_summary)
+    try:
+        with open(summary_path, encoding="utf-8") as f:
+            summary = json.load(f)
+    except (OSError, ValueError):
+        summary = {}
+    summary["tree_parity"] = parity
+    with open(summary_path, "w", encoding="utf-8") as f:
+        json.dump(summary, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return parity
+
+
+def _run_tree(df, model) -> int:
+    """Region-partition tree mode: the 2-region / 3-level drill (see
+    module docstring) followed by the simulator parity gate."""
+    import subprocess
+    import threading
+    import time
+
+    from distkeras_tpu.netps import PSClient
+    from distkeras_tpu.netps.remote import _leaves
+    from distkeras_tpu.netps.tree import TreeSpec
+
+    base = os.environ["DKTPU_PS_STATE_DIR"]
+    os.makedirs(base, exist_ok=True)
+    tree_faults = os.environ.get("NETPS_SMOKE_TREE_FAULTS",
+                                 "ps_crash@12;seed=3")
+    link_key = TreeSpec.link_key(0, 1)
+    link_faults = os.environ.get("NETPS_SMOKE_LINK_FAULTS",
+                                 f"link_down@{link_key}:2.5;seed=3")
+    root_port = _free_port()
+    root_ep = f"127.0.0.1:{root_port}"
+    root_dir = os.path.join(base, "root")
+    procs = [_launch_ps(root_port, root_dir, {})]
+    # Seed the root center with the model's leaves BEFORE any tree node
+    # dials in: interior nodes join upstream with an empty init (their
+    # center IS the root lineage's) and an uninitialized root would
+    # reject them.
+    init = [np.asarray(a, np.float32) for a in _leaves(model.params)]
+    boot = PSClient(root_ep, timeout=1.0, retries=25, backoff=0.2)
+    boot.join(init=init)
+    boot.leave()
+    boot.close()
+
+    r0_port, s0_port, r1_port = _free_port(), _free_port(), _free_port()
+    r0_dir = os.path.join(base, "tree-L0-g0")
+    s0_dir = r0_dir + ".standby"
+    r1_dir = os.path.join(base, "tree-L0-g1")
+    tree_args = ("--tree-spec", "region:2", "--flush-interval", "0.2")
+    # Region 0: the victim. Its OWN plan SIGKILLs it just before fold 12
+    # (mid-run), no goodbye; the fired-faults journal keeps it one-shot.
+    procs.append(_launch_ps(
+        r0_port, r0_dir,
+        {"DKTPU_NET_FAULTS": tree_faults,
+         "DKTPU_FAULTS_STATE": os.path.join(r0_dir, "faults.journal")},
+        "--upstream", root_ep, "--tree-level", "0", "--tree-group", "0",
+        *tree_args))
+    victim = procs[-1]
+    # Its warm region-local standby: tails the journal, promotes on lease
+    # lapse, fences, and takes over the uplink.
+    procs.append(_launch_ps(
+        s0_port, s0_dir, {},
+        "--standby", f"127.0.0.1:{r0_port}", "--upstream", root_ep,
+        "--tree-level", "0", "--tree-group", "0",
+        "--promote-after", "1.5", *tree_args))
+    # Region 1: healthy process, black-holed UPLINK — and a buffer bound
+    # (2 windows) the 2.5 s outage must overrun, forcing typed drops.
+    procs.append(_launch_ps(
+        r1_port, r1_dir,
+        {"DKTPU_NET_FAULTS": link_faults,
+         "DKTPU_FAULTS_STATE": os.path.join(r1_dir, "faults.journal")},
+        "--upstream", root_ep, "--tree-level", "0", "--tree-group", "1",
+        "--tree-buffer", "2", "--fan-in", "1", *tree_args))
+
+    stop = threading.Event()
+
+    def region1_traffic():
+        # Zero-delta commits: region 1 sees real windows, buffering, and
+        # drops without perturbing the center the trainer is converging.
+        # The node subprocess spends seconds importing before it listens,
+        # so the join loops until it answers (or the drill ends).
+        c = None
+        deadline = time.monotonic() + 30.0
+        while not stop.is_set() and time.monotonic() < deadline:
+            try:
+                c = PSClient(f"127.0.0.1:{r1_port}", timeout=1.0,
+                             retries=3, backoff=0.1)
+                c.join(init=init)
+                break
+            except Exception:  # noqa: BLE001 - still booting
+                if c is not None:
+                    c.close()
+                c = None
+                time.sleep(0.2)
+        if c is None:
+            return
+        try:
+            zeros = [np.zeros_like(a) for a in init]
+            while not stop.is_set():
+                _, pulled = c.pull()
+                c.commit(zeros, pulled)
+                stop.wait(0.05)
+        finally:
+            try:
+                c.leave()
+            except Exception:  # noqa: BLE001 - the drill may outlive it
+                pass
+            c.close()
+
+    traffic = threading.Thread(target=region1_traffic, daemon=True)
+    traffic.start()
+    try:
+        trainer = ADAG(model, loss="sparse_categorical_crossentropy",
+                       num_workers=4, batch_size=16, num_epoch=3,
+                       learning_rate=0.1, communication_window=4,
+                       seed=0, remote=f"127.0.0.1:{r0_port},"
+                                      f"127.0.0.1:{s0_port}")
+        trained = trainer.train(df, shuffle=True)
+        # Region 1 must come back up and drain its survivors before the
+        # ledger is read — ride-through, not ride-forever.
+        r1_stats = {}
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            r1_stats = _scrape_tree_stats(f"127.0.0.1:{r1_port}")
+            if (r1_stats and r1_stats["buffered_windows"] == 0
+                    and not r1_stats["link_down"]):
+                break
+            time.sleep(0.2)
+        sb_stats = _scrape_tree_stats(f"127.0.0.1:{s0_port}")
+    finally:
+        stop.set()
+        traffic.join(timeout=5.0)
+        # Crash evidence BEFORE teardown: the terminate/kill escalation
+        # below must never masquerade as the injected ps_crash.
+        victim_crashed = victim.poll() not in (0, None)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(timeout=10)
+    acc = float((np.asarray(trained.predict(jnp.asarray(
+        df["features"]))).argmax(-1) == df["label"]).mean())
+    reg = telemetry.get()
+    walks = reg.counter("netps.endpoint_walks").value
+    journaled = {}
+    for label, sdir in (("root", root_dir), ("region0", r0_dir),
+                        ("region0-standby", s0_dir), ("region1", r1_dir)):
+        records, last_epoch = _assert_journal_invariants(sdir, label)
+        journaled[label] = (len(records), last_epoch)
+    print(f"netps region-partition tree: acc={acc:.4f} "
+          f"journaled={journaled} "
+          f"dropped_windows={r1_stats.get('dropped_windows')} "
+          f"dropped_commits={r1_stats.get('dropped_commits')} "
+          f"silent_loss={r1_stats.get('silent_loss')} "
+          f"endpoint_walks={walks:.0f}")
+    assert victim_crashed, (
+        "region 0's ps_crash never fired — the drill tested nothing")
+    assert journaled["region0-standby"][1] >= 1, (
+        "region 0's standby never promoted past epoch 0")
+    assert sb_stats.get("forwarded", 0) >= 1, (
+        "the promoted standby never flushed a combined window upstream")
+    assert walks >= 1, "no client ever walked the region's endpoint list"
+    assert r1_stats, "region 1's ledger was never scraped"
+    assert r1_stats["link_downs"] >= 1, "region 1's link_down never fired"
+    assert r1_stats["dropped_windows"] >= 1, (
+        "the 2.5 s outage never overran the 2-window buffer: the "
+        "typed-drop path went untested")
+    assert r1_stats["buffered_windows"] == 0, (
+        "region 1 never drained its buffer after the heal")
+    assert r1_stats["silent_loss"] == 0, (
+        f"window conservation violated: {r1_stats}")
+    assert acc >= 0.99, f"accuracy collapsed across the region drill: {acc}"
+    repo_summary = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_SUMMARY.json")
+    _run_tree_parity(repo_summary)
+    return 0
+
+
 def main() -> int:
     rng = np.random.default_rng(0)
     centers = rng.normal(scale=4.0, size=(3, 4))
@@ -420,6 +722,8 @@ def main() -> int:
                     "label": y.astype(np.int32)})
     model = Model.build(MLP(hidden=(16,), num_outputs=3),
                         jnp.zeros((1, 4), jnp.float32), seed=0)
+    if os.environ.get("NETPS_SMOKE_TREE"):
+        return _run_tree(df, model)
     if int(os.environ.get("NETPS_SMOKE_SHARDS") or 0) > 1:
         return _run_sharded(df, model)
     if os.environ.get("DKTPU_PS_STATE_DIR"):

@@ -940,6 +940,76 @@ def test_punchcard_ps_launch_rendering():
     assert "DKTPU_PS_ENDPOINT" not in bare.launch(dry_run=True)[0]
 
 
+def test_tree_depth3_staleness_is_min_of_constituents():
+    """N-level MIN-reduction parity: a 3-level path (worker -> host node
+    -> region node -> root) charges the root the OLDEST constituent's
+    staleness — the same number the flat topology charges that
+    constituent at the same counter, and the same MIN the 2-level
+    aggregator already forwards (the interior hop must not launder it)."""
+    from distkeras_tpu.netps import build_tree
+
+    flat = make_server(discipline="dynsgd")
+    root = make_server(discipline="dynsgd")
+    tree = None
+    try:
+        # Advance both counters to 2 through a direct worker.
+        for srv in (flat, root):
+            with PSClient(srv.endpoint, worker_id=7, **FAST) as direct:
+                _, u = direct.join(init=[np.zeros(4, np.float32)])
+                direct.commit([np.ones(4, np.float32)], u)
+                _, u = direct.pull()
+                direct.commit([np.ones(4, np.float32)], u)
+        # Flat reference at counter 2: the stale commit (pulled=0) is
+        # charged staleness 2 — the oldest-constituent number the tree's
+        # combined window must carry to the root.
+        with PSClient(flat.endpoint, worker_id=1, **FAST) as fb:
+            fb.join()
+            hdr, _ = fb._rpc("commit", {"seq": 0, "pulled": 0},
+                             [np.ones(4, np.float32)])
+            assert hdr["applied"]
+        flat_oldest = max(st for w, _s, st in flat.commit_log if w != 7)
+        assert flat_oldest == 2
+        # Depth-3: host level (fan 2, both workers) under a region level
+        # (fan 1: the single host node). A long flush_interval keeps the
+        # host window open until BOTH constituents are in — the flush is
+        # fan-in-driven, so min(pulled) is a real two-element MIN.
+        tree = build_tree("host:2,region:2", root.endpoint, workers=2,
+                          discipline="dynsgd", flush_interval=5.0)
+        a = PSClient(tree.leaf_endpoint(0), worker_id=0, **FAST)
+        b = PSClient(tree.leaf_endpoint(1), worker_id=1, **FAST)
+        try:
+            _, ua = a.join()
+            b.join()
+            assert ua == 2  # root-lineage counter served at the leaf
+            a.commit([np.ones(4, np.float32)], ua)     # fresh: pulled=2
+            hdr, _ = b._rpc("commit", {"seq": 0, "pulled": 0},
+                            [np.ones(4, np.float32)])  # stale: pulled=0
+            assert hdr["applied"]
+        finally:
+            a.close()
+            b.close()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if any(w != 7 for w, _s, _st in root.commit_log):
+                break
+            time.sleep(0.02)
+        tree_folds = [e for e in root.commit_log if e[0] != 7]
+        # ONE combined window traversed both levels; the root charges it
+        # the oldest constituent's staleness, exactly the flat number.
+        assert len(tree_folds) == 1
+        assert tree_folds[0][2] == flat_oldest == 2
+        # The interior region hop saw the same MIN on its own books (the
+        # 2-level reading): its fold of the host's combined commit was
+        # charged updates(2) - min_pulled(0) = 2 as well.
+        region = tree.node(1, 0)
+        assert [st for _w, _s, st in region.commit_log] == [2]
+    finally:
+        if tree is not None:
+            tree.close()
+        flat.close()
+        root.close()
+
+
 @pytest.mark.slow
 def test_netps_chaos_parity_with_raced_ps(monkeypatch):
     """THE acceptance scenario: the same model/data trained (a) through

@@ -1,0 +1,359 @@
+"""N-level aggregation trees (``netps.tree``): spec grammar, topology
+math, partition ride-through with typed drops, standby promotion with
+exactly-once journals, and the placement/launch rendering that puts the
+gang on real hosts.
+
+The depth-3 staleness parity and chaos-parity runs live in
+``tests/test_netps.py``; the subprocess region-partition drill is the
+``NETPS_SMOKE_TREE`` mode of ``tests/smoke_netps_chaos.py``.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.netps.client import PSClient
+from distkeras_tpu.netps.server import PSServer
+from distkeras_tpu.netps import state as netps_state
+from distkeras_tpu.netps.tree import (TreeNode, TreeSpec, TreeStandby,
+                                      build_tree)
+from distkeras_tpu.resilience import faults
+
+FAST = dict(timeout=1.0, retries=3, backoff=0.01)
+
+
+def _root(n=4, **kw):
+    kw.setdefault("discipline", "adag")
+    return PSServer(center=[np.zeros(n, np.float32)], **kw).start()
+
+
+# ---------------------------------------------------------------------------
+# TreeSpec: grammar + topology math
+# ---------------------------------------------------------------------------
+
+def test_tree_spec_parse_render_roundtrip():
+    spec = TreeSpec.parse("host:8,pool:4,region:2:int8")
+    assert spec.depth == 3
+    assert [l.name for l in spec.levels] == ["host", "pool", "region"]
+    assert [l.fanout for l in spec.levels] == [8, 4, 2]
+    assert [l.codec for l in spec.levels] == [None, None, "int8"]
+    assert spec.render() == "host:8,pool:4,region:2:int8"
+    assert TreeSpec.parse(spec.render()) == spec
+    # Whitespace and empty segments are tolerated (env-var ergonomics).
+    assert TreeSpec.parse(" host:2 ,, region:2 ").render() == "host:2,region:2"
+
+
+@pytest.mark.parametrize("bad", [
+    "host",                  # no fanout
+    "host:xyz",              # non-integer fanout
+    "host:0",                # fanout < 1
+    "host:2:zstd9",          # unknown codec
+    "host:2,host:4",         # duplicate level name
+    "9bad:2",                # bad level name
+    "host:2:int8:extra",     # too many fields
+    "",                      # no levels at all
+])
+def test_tree_spec_rejects_bad_grammar(bad):
+    with pytest.raises(ValueError):
+        TreeSpec.parse(bad)
+
+
+def test_tree_spec_topology_math():
+    spec = TreeSpec.parse("host:2,region:3")
+    # group_of: contiguous, stride = prod(fanouts[:k+1]).
+    assert [spec.group_of(r, 0) for r in range(6)] == [0, 0, 1, 1, 2, 2]
+    assert [spec.group_of(r, 1) for r in range(6)] == [0, 0, 0, 0, 0, 0]
+    assert spec.group_of(6, 1) == 1
+    # nodes_at: ceil-divide, partial subtrees still get a node.
+    assert spec.nodes_at(0, 6) == 3
+    assert spec.nodes_at(0, 7) == 4
+    assert spec.nodes_at(1, 6) == 1
+    assert spec.nodes_at(1, 7) == 2
+    # parent_group chains levels; the top interior level has no parent.
+    assert spec.parent_group(0, 2) == 0
+    assert spec.parent_group(0, 3) == 1
+    with pytest.raises(ValueError):
+        spec.parent_group(1, 0)
+
+
+def test_tree_link_key_encoding():
+    key = TreeSpec.link_key(2, 7)
+    assert key == 2007
+    assert TreeSpec.split_link_key(key) == (2, 7)
+    assert TreeSpec.split_link_key(TreeSpec.link_key(0, 0)) == (0, 0)
+    for level, group in [(-1, 0), (0, -1), (0, 1000)]:
+        with pytest.raises(ValueError):
+            TreeSpec.link_key(level, group)
+
+
+# ---------------------------------------------------------------------------
+# Partition ride-through: bounded buffer, typed drops, zero silent loss
+# ---------------------------------------------------------------------------
+
+def test_tree_partition_buffers_then_drops_typed():
+    """A black-holed uplink buffers up to ``buffer_windows`` combined
+    windows and degrades PAST the bound by counted, typed drops naming
+    their constituents — never silent divergence, never deadlock — then
+    drains the survivors in order on heal."""
+    telemetry.reset()
+    root = _root()
+    node = None
+    try:
+        node = TreeNode(root.endpoint, level=0, group=0,
+                        spec="region:2", fan_in=1, buffer_windows=3,
+                        flush_interval=3600.0, probe_links=False,
+                        **FAST).start()
+        faults.set_net_plan(faults.FaultPlan.parse_net("link_down@0:2.5"))
+        with PSClient(node.endpoint, **FAST) as c:
+            c.join(init=[np.zeros(4, np.float32)])
+            for _ in range(10):
+                _, pulled = c.pull()
+                c.commit([np.ones(4, np.float32)], pulled)
+                node._flush_once(force=True)
+            stats = c.stats()["tree"]  # the ledger rides the stats op
+        assert stats["absorbed"] == 10
+        assert stats["link_down"] is True
+        assert stats["buffered_windows"] == 3
+        assert stats["dropped_windows"] == 7
+        assert stats["dropped_commits"] == 7
+        assert stats["forwarded_commits"] == 0
+        assert stats["silent_loss"] == 0
+
+        # Heal: the buffered survivors drain, in order, exactly once.
+        deadline = time.monotonic() + 8.0
+        while time.monotonic() < deadline:
+            node._flush_once(force=True)
+            if node.tree_stats()["buffered_windows"] == 0:
+                break
+            time.sleep(0.1)
+        stats = node.tree_stats()
+        assert stats["buffered_windows"] == 0
+        assert stats["forwarded_commits"] == 3
+        assert stats["dropped_commits"] == 7
+        assert stats["silent_loss"] == 0
+        assert root.commits_total == 3
+
+        # The drop event names every lost constituent (wid, seq).
+        drops = [e for e in telemetry.get().events()
+                 if e["kind"] == "netps_tree_window_drop"]
+        assert drops, "no netps_tree_window_drop event emitted"
+        assert all(e["reason"] == "buffer_overflow" for e in drops)
+        pairs = [tuple(p) for e in drops for p in e["constituents"]]
+        assert len(pairs) == 7
+        assert len(set(pairs)) == 7
+        downs = [e for e in telemetry.get().events()
+                 if e["kind"] == "netps_tree_link_down"]
+        assert downs and downs[0]["seconds"] == 2.5
+    finally:
+        faults.reset()
+        if node is not None:
+            node.close()
+        root.close()
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# Standby promotion: fence, re-parent, exactly-once journals
+# ---------------------------------------------------------------------------
+
+def test_tree_standby_promotes_fences_and_dedups(tmp_path):
+    """Killing a region aggregator promotes its warm region-local
+    standby: epoch bumps past the dead lineage, children re-parent via
+    their ordinary endpoint walk, and no (wid, seq) ever folds twice in
+    either lineage's journal."""
+    telemetry.reset()
+    root = _root(lease_s=30.0)
+    node = standby = None
+    try:
+        node = TreeNode(root.endpoint, level=0, group=0, spec="region:2",
+                        fan_in=1, flush_interval=0.05, lease_s=2.0,
+                        state_dir=str(tmp_path / "node"),
+                        probe_links=False, **FAST).start()
+        standby = TreeStandby(node.endpoint, upstream=root.endpoint,
+                              level=0, group=0, spec="region:2",
+                              fan_in=1, flush_interval=0.05,
+                              promote_after=0.6,
+                              state_dir=str(tmp_path / "standby"),
+                              probe_links=False, **FAST).start()
+        served = f"{node.endpoint},{standby.endpoint}"
+        with PSClient(served, timeout=1.0, retries=10, backoff=0.05) as c:
+            c.join(init=[np.zeros(4, np.float32)])
+            for _ in range(4):
+                _, pulled = c.pull()
+                c.commit([np.ones(4, np.float32)], pulled)
+            deadline = time.monotonic() + 5.0
+            while node.forwarded < 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert node.forwarded >= 1, "primary never flushed upstream"
+
+            # SIGKILL-equivalent: stop serving without any goodbye.
+            node._stop.set()
+            node._listener.close()
+            deadline = time.monotonic() + 8.0
+            while not standby.promoted and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert standby.promoted, "standby never promoted"
+            assert standby.epoch >= 1
+
+            for _ in range(4):  # the endpoint walk re-parents the child
+                _, pulled = c.pull()
+                c.commit([np.ones(4, np.float32)], pulled)
+        deadline = time.monotonic() + 5.0
+        while standby.forwarded < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert standby.absorbed >= 4
+        assert standby.forwarded >= 1
+
+        # Exactly-once evidence, per lineage journal.
+        for label, sdir in (("node", tmp_path / "node"),
+                            ("standby", tmp_path / "standby")):
+            records = netps_state.read_journal(str(sdir))
+            seen = set()
+            last_epoch = -1
+            for r in records:
+                key = (int(r["wid"]), int(r["seq"]))
+                assert key not in seen, f"{label}: {key} folded twice"
+                seen.add(key)
+                assert int(r["e"]) >= last_epoch
+                last_epoch = int(r["e"])
+        sb_records = netps_state.read_journal(str(tmp_path / "standby"))
+        assert max(int(r["e"]) for r in sb_records) >= 1
+        # The root saw both lineages' uplinks, each pair exactly once.
+        seen = set()
+        for wid, seq, _st in root.commit_log:
+            assert (wid, seq) not in seen
+            seen.add((wid, seq))
+        assert standby.tree_stats()["silent_loss"] == 0
+    finally:
+        if standby is not None:
+            standby.close()
+        if node is not None:
+            try:
+                node.close()
+            except Exception:
+                pass
+        root.close()
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# In-process assembly
+# ---------------------------------------------------------------------------
+
+def test_build_tree_shape_and_leaf_routing():
+    root = _root()
+    tree = None
+    try:
+        tree = build_tree("host:2,region:2", root.endpoint, workers=4,
+                          flush_interval=0.05, probe_links=False, **FAST)
+        assert set(tree.nodes[0]) == {0, 1}
+        assert set(tree.nodes[1]) == {0}
+        # Leaves route to their own host-level node.
+        assert tree.leaf_endpoint(0) == tree.node(0, 0).endpoint
+        assert tree.leaf_endpoint(1) == tree.node(0, 0).endpoint
+        assert tree.leaf_endpoint(2) == tree.node(0, 1).endpoint
+        # Level-0 nodes flush into the region node, which flushes to root.
+        assert tree.node(0, 0).upstream == tree.node(1, 0).endpoint
+        assert tree.node(1, 0).upstream == root.endpoint
+        # Caps advertise the tree coordinates to any client that dials in.
+        with PSClient(tree.leaf_endpoint(0), **FAST) as c:
+            c.join(init=[np.zeros(4, np.float32)])
+            hdr = c.stats()["tree"]
+            assert (hdr["level"], hdr["group"]) == (0, 0)
+            assert hdr["spec"] == "host:2,region:2"
+    finally:
+        if tree is not None:
+            tree.close()
+        root.close()
+
+
+# ---------------------------------------------------------------------------
+# Gang placement + launch rendering
+# ---------------------------------------------------------------------------
+
+def test_place_tree_port0_plan_region_local_standbys():
+    from distkeras_tpu.fleet.placement import place_tree
+
+    plan = place_tree("host:2,region:2", workers=4,
+                      hosts=["h0", "h1", "h2", "h3"],
+                      root_endpoint="root:7077", reserve=False)
+    n00, n01, n10 = plan.node(0, 0), plan.node(0, 1), plan.node(1, 0)
+    # Each node on the FIRST host of its subtree, standby on the NEXT
+    # distinct host of the SAME subtree — region-local by construction.
+    assert (n00.host, n00.standby_host) == ("h0", "h1")
+    assert (n01.host, n01.standby_host) == ("h2", "h3")
+    assert (n10.host, n10.standby_host) == ("h0", "h1")
+    assert all(n.port == 0 for n in plan)  # dry plan consumes no pool
+    # Endpoint-complete: children dial the parent's failover list.
+    assert n10.upstream == "root:7077"
+    assert n00.upstream == n10.served_endpoint == "h0:0,h1:0"
+    assert plan.leaf_endpoint(3) == n01.served_endpoint == "h2:0,h3:0"
+    assert n00.link_key == TreeSpec.link_key(0, 0)
+    assert sorted(plan.all_state_labels()) == sorted([
+        "tree-L0-g0", "tree-L0-g0.standby",
+        "tree-L0-g1", "tree-L0-g1.standby",
+        "tree-L1-g0", "tree-L1-g0.standby"])
+
+
+def test_place_tree_no_standbys_and_ring_fallback():
+    from distkeras_tpu.fleet.placement import place_tree
+
+    plan = place_tree("host:2", workers=2, hosts=["h0", "h1"],
+                      root_endpoint="r:1", standbys=False, reserve=False)
+    n = plan.node(0, 0)
+    assert n.standby_host is None and n.standby_endpoint is None
+    assert n.served_endpoint == n.endpoint  # no comma, nothing to walk
+    # A 1-host subtree falls back to the ring neighbor for its standby.
+    plan = place_tree("host:1,region:2", workers=2, hosts=["a", "b"],
+                      root_endpoint="r:1", reserve=False)
+    assert plan.node(0, 0).host == "a"
+    assert plan.node(0, 0).standby_host == "b"
+    # Callable reserve routes allocation through the caller.
+    taken = []
+
+    def take(host):
+        taken.append(host)
+        return 9000 + len(taken)
+
+    plan = place_tree("host:2", workers=2, hosts=["h0", "h1"],
+                      root_endpoint="r:1", reserve=take)
+    assert plan.node(0, 0).port == 9001
+    assert plan.node(0, 0).standby_port == 9002
+    assert taken == ["h0", "h1"]
+
+
+def test_punchcard_tree_plan_and_launch_lines():
+    from distkeras_tpu.job_deployment import Job, Punchcard
+
+    pc = Punchcard(job_name="tree-job", script="train.py",
+                   hosts=["h0", "h1", "h2", "h3"], coordinator_port=8476,
+                   ps={"tree": "host:2,region:2", "host": "h0",
+                       "port": 7171, "discipline": "dynsgd",
+                       "tree_buffer": 5, "state_dir": "/var/dk"})
+    try:
+        plan = pc.tree_plan()
+        assert pc.tree_plan() is plan  # sticky, like every port pin
+        assert all(n.port > 0 for n in plan)  # gang ports are real
+        job = Job(pc)
+        cmds = job.render_tree_commands()
+        assert len(cmds) == 6  # 3 nodes + 3 standbys, standby after node
+        assert all("python -m distkeras_tpu.netps" in c for c in cmds)
+        assert all("--tree-spec host:2,region:2" in c for c in cmds)
+        assert all("--tree-buffer 5" in c for c in cmds)
+        assert sum("--standby " in c for c in cmds) == 3
+        assert "--tree-level 0 --tree-group 0" in cmds[0]
+        assert f"--upstream {plan.node(1, 0).served_endpoint}" in cmds[0]
+        assert "--state-dir /var/dk/tree-L0-g0" in cmds[0]
+        assert "--state-dir /var/dk/tree-L0-g0.standby" in cmds[1]
+        # The top node flushes into the ROOT's endpoint, not another node.
+        top = [c for c in cmds if "--tree-level 1" in c][0]
+        assert "--upstream h0:7171" in top
+        # Workers dial their OWN level-0 node and mirror the spec.
+        worker_cmds = job.render_commands()
+        assert f"DKTPU_PS_ENDPOINT={plan.leaf_endpoint(0)}" in worker_cmds[0]
+        assert f"DKTPU_PS_ENDPOINT={plan.leaf_endpoint(2)}" in worker_cmds[2]
+        assert "DKTPU_TREE_SPEC=host:2,region:2" in worker_cmds[0]
+    finally:
+        pc.release_ports()
