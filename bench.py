@@ -499,7 +499,13 @@ def _measure_netps_transformer(name, *, num_layers, d_model, num_heads, d_ff,
     * ``shm``        — the PR 5 knobs over the same-host shared-memory
       ring (`DKTPU_NET_TRANSPORT=shm`): payloads via mmap, doorbell on a
       UDS — the PR 6 fast path. ``shm_vs_tcp_optimized`` is the headline
-      A/B (acceptance: >= 1.5x).
+      A/B (acceptance: >= 1.5x);
+    * ``mesh``       — the device-resident center
+      (`DKTPU_NET_TRANSPORT=mesh`): same-process workers fold through the
+      in-process dispatch into donated device buffers, zero wire bytes.
+      ``mesh_vs_inprocess`` is its acceptance ratio (>= 1.0: the dialect
+      must meet the in-process engine fold, the ceiling every wire
+      dialect chases).
 
     The headline value is the shm path (the dialect a colocated deployment
     negotiates); ``data_plane_ab`` records all four plus the recovered
@@ -654,6 +660,15 @@ def _measure_netps_transformer(name, *, num_layers, d_model, num_heads, d_ff,
     # ring wins (measured; the codec stays a TCP/cross-host lever).
     shm_v = run_variant(transport="shm", inflight=2, shards=1,
                         compress="none")
+    # -- the mesh arm: the device-resident center (PR 20) ------------------
+    # Same-process workers fold through the in-process dispatch into
+    # donated device buffers — zero wire bytes, zero payload copies. The
+    # ring's knob rule applies a fortiori (f32, one lane); the headline
+    # ratio is against the IN-PROCESS engine fold, the ceiling every wire
+    # dialect chases (acceptance: >= 1.0 — the dialect must close the RPC
+    # gap outright, not just narrow it).
+    mesh_v = run_variant(transport="mesh", inflight=2, shards=1,
+                         compress="none")
     # -- the auto arm: the self-tuning controller from a COLD start --------
     # No data-plane knobs at all: join-time probes + the online control
     # loop pick codec/inflight/striping (the acceptance bar is matching
@@ -728,8 +743,12 @@ def _measure_netps_transformer(name, *, num_layers, d_model, num_heads, d_ff,
             "pr4_tokens_per_sec": round(pr4["value"], 1),
             "optimized_tokens_per_sec": round(opt["value"], 1),
             "shm_tokens_per_sec": round(shm_v["value"], 1),
+            "mesh_tokens_per_sec": round(mesh_v["value"], 1),
             "optimized_vs_pr4": round(opt["value"] / pr4["value"], 3),
             "shm_vs_tcp_optimized": round(shm_v["value"] / opt["value"], 3),
+            "mesh_vs_inprocess": (round(mesh_v["value"] / inproc, 3)
+                                  if inproc > 0 else None),
+            "mesh_vs_shm": round(mesh_v["value"] / shm_v["value"], 3),
             "durable_tokens_per_sec": round(
                 opt["value"] / durable_ratio, 1),
             "durable_overhead_vs_optimized": round(durable_ratio - 1.0, 3),
@@ -1514,8 +1533,12 @@ def main():
                 rec = {"metric": f"{name}_{kind}_per_sec_per_chip",
                        "value": None, "unit": f"{kind}/s/chip",
                        "error": f"{type(e).__name__}: {e}"}
+        # Every config record carries its config NAME alongside the derived
+        # metric string, so summary consumers (the regression sentinel, ad
+        # hoc jq) select configs without re-parsing metric suffixes.
+        rec.setdefault("name", name)
         tele.event("bench_config", {k: rec.get(k) for k in
-                                    ("metric", "value", "unit",
+                                    ("name", "metric", "value", "unit",
                                      "input_stall_fraction", "error")
                                     if rec.get(k) is not None})
         entry = pins.get(rec["metric"]) if rec.get("value") else None

@@ -62,6 +62,7 @@ from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
 
+from distkeras_tpu.netps import mesh as _mesh
 from distkeras_tpu.netps import shm, wire
 from distkeras_tpu.netps.endpoints import EndpointWalker, budget_left
 from distkeras_tpu.netps.errors import (
@@ -158,6 +159,19 @@ def _validate_knob_combo(codec: str, transport: str, shards: int) -> None:
             "striping over the shm ring pays a doorbell per stripe for "
             "payloads that already move at memcpy speed; prefer "
             "DKTPU_NET_SHARDS=1"))
+    if transport == "mesh" and codec == wire.CODEC_INT8:
+        combos.append((
+            "int8+mesh",
+            "the mesh dialect moves zero wire bytes, so the int8 codec "
+            "buys nothing and still pays the quantization error plus the "
+            "encode/decode passes; prefer DKTPU_NET_COMPRESS=none"))
+    if transport == "mesh" and shards > 1:
+        combos.append((
+            "shards>1+mesh",
+            "striping splits commits across sockets the mesh dialect "
+            "never opens — every stripe lands on the same in-process "
+            "dispatch and the server just reassembles them; prefer "
+            "DKTPU_NET_SHARDS=1"))
     for combo, why in combos:
         if combo in _BAD_KNOB_COMBOS_WARNED:
             continue
@@ -230,6 +244,13 @@ class PSClient:
         #: the server's advertised ring endpoint when the same-host check
         #: passed (``{"boot_id", "uds"}``), else None (TCP dialect).
         self.shm_info: Optional[dict] = None
+        #: the server's advertised device-mesh dispatch when the
+        #: same-runtime check passed (``{"proc", "token", ...}``), else
+        #: None. Set only under ``transport="mesh"`` against a same-process
+        #: peer; a mesh failure sweeps it (one strike — a lost device mesh
+        #: does not heal) and the client demotes to its ALSO-negotiated
+        #: shm/TCP dialect without dropping the in-flight window.
+        self.mesh_info: Optional[dict] = None
         self.lease_s: Optional[float] = None
         #: the primary epoch the last join adopted (None until a join
         #: against an epoch-aware server); rides in every pull/commit/
@@ -303,6 +324,8 @@ class PSClient:
     @property
     def active_transport(self) -> str:
         """The dialect the data connections speak right now."""
+        if self.mesh_info is not None:
+            return "mesh"
         return "shm" if self.shm_info is not None else "tcp"
 
     @property
@@ -332,6 +355,9 @@ class PSClient:
             # shared lock, which IS that lock (see __init__) — the
             # analyzer can't see through the callback indirection.
             self.shm_info = None  # dk: disable=DK202
+            # The next endpoint is a different process: no device mesh of
+            # ours lives there (the same-runtime check would fail anyway).
+            self.mesh_info = None  # dk: disable=DK202
             self.walk_count += 1
             for conn in self._conns:
                 self._disconnect(conn)
@@ -420,11 +446,13 @@ class PSClient:
                 hdr.setdefault("worker_id", int(self.worker_id))
             # Per-shard RPC spans: stripe sub-RPCs are labeled by their
             # shard so the report can show per-stripe latency skew. The
-            # transport dialect labels the span too (``.shm``; bare = TCP,
-            # the historical names) so the report CLI can attribute RPC
-            # time per dialect — computed PER ATTEMPT, so the TCP attempts
-            # after a mid-RPC shm fallback are not billed to the ring.
-            dialect = ".shm" if self.shm_info is not None else ""
+            # transport dialect labels the span too (``.mesh``/``.shm``;
+            # bare = TCP, the historical names) so the report CLI can
+            # attribute RPC time per dialect — computed PER ATTEMPT, so
+            # the TCP attempts after a mid-RPC demotion are not billed to
+            # the faster dialect they fell off of.
+            dialect = (".mesh" if self.mesh_info is not None
+                       else ".shm" if self.shm_info is not None else "")
             label = (f"netps.rpc.{op}.s{header['shard']}{dialect}"
                      if "shard" in header else f"netps.rpc.{op}{dialect}")
             ep_seen = self._ep_idx
@@ -451,6 +479,22 @@ class PSClient:
                     raise  # the server said no; asking again won't help
                 last_exc = e
                 self._disconnect(conn)
+                if self.mesh_info is not None:
+                    # Mesh demotion is ONE strike (the shm ring retries
+                    # once first; a lost device mesh does not heal): null
+                    # the dispatch info and the NEXT attempt of this same
+                    # RPC lands on the negotiated shm/TCP dialect with the
+                    # same seq — the in-flight window rides through and
+                    # the server's dedup keeps it exactly-once. Only the
+                    # sweeping thread counts the demotion.
+                    with self._fallback_lock:
+                        swept = self.mesh_info is not None
+                        if swept:
+                            self.mesh_info = None
+                    if swept:
+                        telemetry.counter("netps.mesh.demotions").add(1)
+                        telemetry.event("netps_mesh_demotion",
+                                        {"why": f"{type(e).__name__}: {e}"})
                 if self.shm_info is not None and (
                         attempt >= 1 or attempt + 1 == attempts):
                     # Two ring failures in a row (a transient fault retries
@@ -516,6 +560,22 @@ class PSClient:
         from distkeras_tpu import telemetry
 
         deadline = time.monotonic() + self.timeout
+        minfo = self.mesh_info
+        if minfo is not None:
+            # The mesh dialect: one direct in-process call — no socket, no
+            # frame, no copy. The server's dispatch enforces the identical
+            # op contract (dedup, lease, fence) under its own lock; a gone
+            # peer or an injected ``mesh_down`` raises ConnectionError
+            # into the demotion sweep above.
+            rhdr, rarrays = _mesh.dispatch(minfo["token"], hdr, list(arrays))
+            err = rhdr.get("error")
+            if err:
+                exc = _ERROR_TYPES.get(err, NetPSError)(
+                    f"{hdr['op']}: server said {err}: "
+                    f"{rhdr.get('message', '')}")
+                exc.from_reply = True
+                raise exc
+            return rhdr, rarrays
         # One read: a sibling stripe thread's shm->TCP fallback may null
         # shm_info at any point; this attempt finishes on the dialect it
         # started with (a closed ring raises the retryable taxonomy).
@@ -699,15 +759,33 @@ class PSClient:
         # change. A re-join that lands on a different answer (e.g. a
         # restarted TCP-only server) tears the stale connections down.
         adv = caps.get("shm")
-        info = (adv if self.transport == "shm" and isinstance(adv, dict)
+        # A mesh client negotiates the ring TOO: it is the demotion target
+        # (mesh -> shm -> TCP) — losing the device mesh must not mean
+        # falling all the way to sockets when the ring is one step down.
+        info = (adv if self.transport in ("shm", "mesh")
+                and isinstance(adv, dict)
                 and adv.get("uds") and adv.get("boot_id") == shm.local_boot_id()
                 and shm.endpoint_visible(adv["uds"])
                 else None)
+        # Same-runtime mesh upgrade: only when this client asked for mesh
+        # AND the server's live advertisement proves the SAME jax runtime
+        # (same boot, same process — device buffers do not cross either).
+        madv = caps.get("mesh")
+        minfo = (madv if self.transport == "mesh" and isinstance(madv, dict)
+                 and madv.get("token")
+                 and madv.get("proc") == _mesh.local_mesh_id()
+                 else None)
         with self._fallback_lock:  # vs a concurrent fallback sweep
             if (info is None) != (self.shm_info is None):
                 for conn in self._conns:
                     self._disconnect(conn)
             self.shm_info = info
+            upgraded = minfo is not None and self.mesh_info is None
+            self.mesh_info = minfo
+        if upgraded:
+            from distkeras_tpu import telemetry
+
+            telemetry.counter("netps.mesh.upgrades").add(1)
         # Error feedback restarts on every (re)join: the residual belongs
         # to the window lineage the rejoin just discarded.
         self._residual = None
@@ -731,6 +809,7 @@ class PSClient:
         self.epoch = other.epoch
         with self._fallback_lock:  # vs a concurrent fallback sweep
             self.shm_info = other.shm_info
+            self.mesh_info = other.mesh_info
         self._compute_stripes(template)
 
     # -- self-tuning surface (netps/tuner/) ---------------------------------

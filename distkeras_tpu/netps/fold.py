@@ -164,6 +164,29 @@ def _reset_accel() -> None:
     _ACCEL_RESOLVED = False
 
 
+def backend_name() -> str:
+    """The resolved compressed-fold backend's name, for the server stats
+    scrape and the chaos smokes (which assert which arithmetic actually
+    ran): ``numpy`` (the pure reference), ``pallas-tpu`` (the fused
+    kernel on a real chip), ``pallas-interpret`` (the same kernel under
+    the interpreter — test/parity runs that force ``_ACCEL``), or
+    ``unresolved`` before the first codec'd commit resolves it. A
+    device-resident center reports ``mesh`` one level up (the server
+    overrides — the mesh dialect folds through its own jitted collective,
+    not this dispatch point)."""
+    if not _ACCEL_RESOLVED:
+        return "unresolved"
+    if _ACCEL is None:
+        return "numpy"
+    try:
+        import jax
+
+        tpu = jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - jax vanished mid-run
+        tpu = False
+    return "pallas-tpu" if tpu else "pallas-interpret"
+
+
 def resolve_backend():
     """Resolve (and cache) the compressed-fold backend NOW; returns it (or
     None). Callers that hold a lock across :func:`fold_delta` must call

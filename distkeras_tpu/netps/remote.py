@@ -251,15 +251,17 @@ def run_remote(
         # Explicit knobs win where set; the controller fills the rest.
         # An unpinned inflight starts at 2 (the overlap window must exist
         # before hidden_fraction can be measured) and the control loop
-        # walks it from there; an unpinned transport requests the ring
-        # (negotiated — cross-host pairs silently stay on TCP).
+        # walks it from there; an unpinned transport requests the TOP of
+        # the demotion ladder (negotiated — a mesh request lands on the
+        # device dispatch only against a same-runtime server, on the ring
+        # for a same-host one, and cross-host pairs silently stay on TCP).
         tuner = Tuner(W, inflight=inflight if explicit_inflight
                       else max(inflight, 2))
         inflight = tuner.inflight
         if transport is None and not config.env_is_set("DKTPU_NET_TRANSPORT"):
-            transport = "shm"
+            transport = "mesh"
         if (shards is None and not config.env_is_set("DKTPU_NET_SHARDS")
-                and transport != "shm"):
+                and transport not in ("shm", "mesh")):
             # Striping headroom on TCP: connections are sized at
             # construction, so a client that might be retuned UP to 2
             # stripes mid-run needs 2 conns now (active stripes still

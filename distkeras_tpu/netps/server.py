@@ -52,10 +52,12 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from distkeras_tpu.netps import mesh as _mesh
 from distkeras_tpu.netps import shm, wire
 from distkeras_tpu.netps import state as _state
 from distkeras_tpu.netps.errors import ProtocolError
-from distkeras_tpu.netps.fold import (check_discipline, counter_staleness,
+from distkeras_tpu.netps.fold import (backend_name, check_discipline,
+                                      commit_scale, counter_staleness,
                                       decode_entry, fold_delta,
                                       resolve_backend, validate_delta)
 from distkeras_tpu.resilience import faults as _faults
@@ -127,6 +129,16 @@ class PSServer:
         self._lock = threading.Lock()
         self._center = (None if center is None
                         else [np.array(a, np.float32) for a in center])
+        #: device-resident center (``transport="mesh"``): folds run through
+        #: the jitted collective in :class:`netps.mesh.MeshFolder` and
+        #: ``self._center`` becomes its lazily-synced host mirror (every
+        #: read goes through :meth:`_host_center_locked`). ``None`` means
+        #: host folds — never built yet, build failed, or demoted mid-run.
+        self._mesh_folder: Optional[_mesh.MeshFolder] = None
+        self._mesh_token: Optional[str] = None
+        self._mesh_failed = False
+        self._mesh_demote_reason: Optional[str] = None
+        self._last_fold_mesh = False
         self._updates = 0
         self.lease_s = float(lease_s if lease_s is not None
                              else config.env_float("DKTPU_PS_LEASE"))
@@ -246,7 +258,10 @@ class PSServer:
         self._uds_path: Optional[str] = None
         self._uds_listener: Optional[socket.socket] = None
         self._uds_accept_thread: Optional[threading.Thread] = None
-        if self.transport == "shm":
+        # A mesh server serves the ring too: the demotion ladder
+        # (mesh -> shm -> tcp) needs the next rung advertised in the same
+        # join reply the mesh bit rides in.
+        if self.transport in ("shm", "mesh"):
             self._uds_dir = tempfile.mkdtemp(prefix="dknetps-")
             self._uds_path = os.path.join(self._uds_dir, "ring.sock")
             self._uds_listener = socket.socket(socket.AF_UNIX,
@@ -268,7 +283,19 @@ class PSServer:
         with self._lock:
             if self._center is None:
                 return []
-            return [a.copy() for a in self._center]
+            return [a.copy() for a in self._host_center_locked()]
+
+    def _host_center_locked(self) -> list:
+        """The host view of the center (lock held): ``self._center``
+        itself when folds are host-side, or the mesh folder's synced
+        mirror when the center lives on device. Every read path (pull
+        replies, join inits, snapshots, replication, :meth:`center`)
+        comes through here so a device-resident fold is never served
+        stale."""
+        if self._mesh_folder is not None:
+            # Caller holds self._lock (the `_locked` suffix contract).
+            self._center = self._mesh_folder.center_host()  # dk: disable=DK202
+        return self._center
 
     def members(self) -> list:
         with self._lock:
@@ -293,7 +320,51 @@ class PSServer:
                                  name="netps-shm-accept")
             t.start()
             self._uds_accept_thread = t
+        if self.transport == "mesh":
+            self._mesh_token = _mesh.register(self._serve_mesh)
+            self._ensure_mesh_folder()
         return self
+
+    def _ensure_mesh_folder(self) -> None:
+        """Seat the center on device (idempotent; no-op until a center
+        exists). The jax import/device init happens OUTSIDE the center
+        lock — same discipline as ``resolve_backend`` — then the folder is
+        built from the live center under it. A build failure demotes this
+        server to host folds permanently (``_mesh_failed``): every wire
+        guarantee still holds, only the dialect advertisement is gone."""
+        if (self.transport != "mesh" or self._mesh_failed
+                or self._mesh_folder is not None):
+            return
+        if not _mesh.mesh_available():
+            self._mesh_failed = True
+            return
+        plan = (self.shard_plan
+                if self.shard_plan is not None and self.shard_index is None
+                else None)
+        try:
+            with self._lock:
+                if self._mesh_folder is None and self._center is not None:
+                    self._mesh_folder = _mesh.MeshFolder(self._center,
+                                                         plan=plan)
+        except Exception as e:  # noqa: BLE001 - demote, never refuse boot
+            self._mesh_failed = True
+            from distkeras_tpu import telemetry
+            telemetry.counter("netps.mesh.demotions").add(1)
+            telemetry.event("netps_mesh_demotion",
+                            {"why": f"build: {type(e).__name__}: {e}"})
+
+    def _serve_mesh(self, header: dict, arrays: list):
+        """One direct in-process request (the mesh dialect's data path):
+        no frames, no sockets, no copies — straight into the
+        transport-independent dispatch, with the payload bytes counted as
+        received. Runs on the CLIENT's thread; the center lock provides
+        the same serialization the socket handler threads get."""
+        nbytes = 0
+        for entry in arrays:
+            a = entry[0] if isinstance(entry, tuple) else entry
+            nbytes += np.asarray(a).nbytes
+        return self._serve_frame(wire.KIND_REQUEST, nbytes, header, arrays,
+                                 dialect=".mesh")
 
     def drain(self) -> None:
         """Enter draining mode: commits and joins are rejected with a typed
@@ -307,6 +378,12 @@ class PSServer:
         """Graceful shutdown: :meth:`drain`, then stop and join every
         thread (accept loop, per-connection handlers, lease monitor) and
         release the listener. Idempotent."""
+        # Unregister the mesh dispatch first: in-flight mesh clients see
+        # ConnectionError and demote to the ring/TCP (where drain answers
+        # them typed) instead of racing a dying dispatch target.
+        if self._mesh_token is not None:
+            _mesh.unregister(self._mesh_token)
+            self._mesh_token = None
         self.drain()
         self._stop.set()
         if self._store is not None:
@@ -319,6 +396,14 @@ class PSServer:
             self._monitor_thread.join()
         for t in list(self._threads):
             t.join()
+        if self._mesh_folder is not None:
+            # Sync the host mirror before releasing the device buffers —
+            # post-close reads (tests asserting on the final center) must
+            # see every fold.
+            with self._lock:
+                self._center = self._mesh_folder.center_host()
+                self._mesh_folder.close()
+                self._mesh_folder = None
         try:
             self._listener.close()
         except OSError:
@@ -528,7 +613,8 @@ class PSServer:
             self._chaos_hooks()
         with telemetry.span(f"netps.server.{op or 'unknown'}{dialect}"):
             with _tracing.adopt(tctx):
-                reply, out = self._dispatch(op, header, arrays)
+                reply, out = self._dispatch(op, header, arrays,
+                                            dialect=dialect)
         err = reply.get("error")
         if op == wire.OP_COMMIT and err == "epoch_fenced":
             # The zero-stale-epoch-folds evidence: every fenced commit is
@@ -578,12 +664,12 @@ class PSServer:
                 plan.fire("shard_crash", self.shard_index)
                 os.kill(os.getpid(), signal.SIGKILL)
 
-    def _dispatch(self, op: str, header: dict,
-                  arrays: list) -> tuple[dict, list]:
+    def _dispatch(self, op: str, header: dict, arrays: list,
+                  dialect: str = "") -> tuple[dict, list]:
         if op == wire.OP_JOIN:
             return self._op_join(header, arrays)
         if op == wire.OP_PULL:
-            return self._op_pull(header)
+            return self._op_pull(header, dialect=dialect)
         if op == wire.OP_COMMIT:
             return self._op_commit(header, arrays)
         if op == wire.OP_HEARTBEAT:
@@ -752,11 +838,14 @@ class PSServer:
             self._purge_pending(wid)  # a rejoin abandons half-sent stripes
             if rejoin:
                 self.rejoins += 1
-            center = [a.copy() for a in self._center]
+            center = [a.copy() for a in self._host_center_locked()]
             updates = self._updates
             last_seq = self._last_seq.get(wid, -1)
             sharding = (self._sharding_caps_locked()
                         if self.shard_index is not None else None)
+        # A join may have just seeded the first center: seat it on device
+        # before advertising the mesh bit (jax init outside the lock).
+        self._ensure_mesh_folder()
         if rejoin:
             telemetry.counter("netps.rejoins").add(1)
             telemetry.event("netps_rejoin", {"worker": wid})
@@ -772,6 +861,15 @@ class PSServer:
         caps = self._caps()
         if self._uds_path is not None and "shm" in caps:
             caps["shm"] = {"boot_id": self._boot_id, "uds": self._uds_path}
+        if (self._mesh_token is not None and self._mesh_folder is not None
+                and "mesh" in caps):
+            # Same replace-the-static-bit pattern: the live advertisement
+            # carries the in-process dispatch token plus the same-runtime
+            # identity the client must match to upgrade.
+            caps["mesh"] = {"proc": _mesh.local_mesh_id(),
+                            "token": self._mesh_token,
+                            "devices": self._mesh_folder.num_devices,
+                            "backend": self._mesh_folder.backend}
         if sharding is not None:
             # A shard server replaces the static bit with its identity +
             # plan, the same pattern the shm upgrade uses.
@@ -780,7 +878,7 @@ class PSServer:
                  "lease_s": self.lease_s, "last_seq": last_seq,
                  "epoch": self.epoch, "caps": caps}, center)
 
-    def _op_pull(self, header: dict) -> tuple[dict, list]:
+    def _op_pull(self, header: dict, dialect: str = "") -> tuple[dict, list]:
         wid = header.get("worker_id")
         idx = header.get("idx")
         with self._lock:
@@ -805,14 +903,28 @@ class PSServer:
                     return self._err(
                         "lease_expired", f"worker {wid} is not a member")
                 self._members[int(wid)] = time.monotonic() + self.lease_s
+            host = self._host_center_locked()
             if idx is None:
-                out = [a.copy() for a in self._center]
+                if dialect == ".mesh" and self._mesh_folder is not None:
+                    # Zero-copy pull for the mesh dialect: while the
+                    # center lives on device, the host mirror is only
+                    # ever REPLACED wholesale (a fold drops it; demotion
+                    # copies before adopting it) — never written in
+                    # place — so same-process clients can read these
+                    # rows directly. Pin that contract by freezing them;
+                    # the wire dialects keep copying because their reply
+                    # buffers outlive the lock inside a serializer.
+                    for a in host:
+                        a.flags.writeable = False
+                    out = list(host)
+                else:
+                    out = [a.copy() for a in host]
             else:
                 # One stripe of the center (striped pull). The reply echoes
                 # the update counter; the client cross-checks counters over
                 # its stripes and re-pulls a torn read.
                 try:
-                    out = [self._center[int(i)].copy() for i in idx]
+                    out = [host[int(i)].copy() for i in idx]
                 except (IndexError, TypeError, ValueError):
                     return self._err(
                         "protocol", f"bad pull stripe indices {idx!r}")
@@ -918,6 +1030,14 @@ class PSServer:
             else:
                 staleness = self._fold_locked(wid, seq, pulled, arrays)
             updates = self._updates
+            mesh_folded = self._last_fold_mesh and not (duplicate or pending)
+            demote_reason, self._mesh_demote_reason = \
+                self._mesh_demote_reason, None
+        if demote_reason:
+            telemetry.counter("netps.mesh.demotions").add(1)
+            telemetry.event("netps_mesh_demotion", {"why": demote_reason})
+        if mesh_folded:
+            telemetry.counter("netps.mesh.folds").add(1)
         if duplicate:
             telemetry.counter("netps.commits_deduped").add(1)
         elif not pending:
@@ -938,9 +1058,35 @@ class PSServer:
         buffer, and the commit-log bound."""
         staleness = counter_staleness(self._updates, pulled)
         t0 = time.perf_counter()
+        mesh_folded = False
         with _tracing.child_scope("commit.fold", wid=wid, seq=seq,
                                   staleness=staleness):
-            fold_delta(self._center, delta, self.discipline, staleness)
+            folder = self._mesh_folder
+            if folder is not None:
+                try:
+                    folder.fold(delta,
+                                commit_scale(self.discipline, staleness))
+                    mesh_folded = True
+                except Exception as e:  # noqa: BLE001 - any failure demotes
+                    # The collective program is functional — nothing
+                    # mutated on a raise — so the host mirror is the
+                    # pre-fold center and the numpy fold below applies
+                    # this delta exactly once. COPY on adoption: the
+                    # mirror's arrays are device_get views (read-only on
+                    # CPU) and may be aliased by zero-copy mesh pull
+                    # replies — the in-place numpy folds below need
+                    # private writable buffers. Telemetry for the
+                    # demotion is deferred past the lock (DK201). Caller
+                    # holds self._lock (the `_locked` suffix contract).
+                    self._center = [np.array(a) for a  # dk: disable=DK202
+                                    in folder.center_host()]
+                    self._mesh_folder = None  # dk: disable=DK202
+                    self._mesh_failed = True
+                    self._mesh_demote_reason = f"{type(e).__name__}: {e}"
+                    folder.close()
+            if not mesh_folded:
+                fold_delta(self._center, delta, self.discipline, staleness)
+        self._last_fold_mesh = mesh_folded
         self._fold_stats = (len(delta), time.perf_counter() - t0)
         u = self._updates
         self.commit_log.append((wid, seq, staleness))
@@ -990,7 +1136,8 @@ class PSServer:
         held; the store is deliberately telemetry-free under it — the
         dispatch layer exports ``netps.recovery.snapshots`` after release)
         and trim the in-memory commit log to its keep bound."""
-        self._store.snapshot(center=self._center, updates=self._updates,
+        self._store.snapshot(center=self._host_center_locked(),
+                             updates=self._updates,
                              last_seq=self._last_seq, epoch=self.epoch,
                              commits_total=self.commits_total)
         self.snapshots_written += 1
@@ -1107,7 +1254,12 @@ class PSServer:
                      # whole point of the membership-free op) but report
                      # not-ready until promoted; fenced/draining likewise.
                      "ready": (not self._draining and not self._fenced
-                               and not self._not_primary)}
+                               and not self._not_primary),
+                     # Which arithmetic actually folds commits right now:
+                     # a live device-resident center reports "mesh"; the
+                     # compressed-domain dispatch's resolution otherwise.
+                     "fold_backend": ("mesh" if self._mesh_folder is not None
+                                      else backend_name())}
         # The ring rides the JSON header: round-trip through json with a
         # str fallback first — event fields may carry non-JSON scalars,
         # and a scrape must never poison the reply frame.
@@ -1169,7 +1321,7 @@ class PSServer:
                        "commits_total": self.commits_total,
                        "last_seq": {str(k): int(v)
                                     for k, v in self._last_seq.items()}}
-                return hdr, [a.copy() for a in self._center]
+                return hdr, [a.copy() for a in self._host_center_locked()]
             recs = recs[:_REPL_BATCH]
             headers = []
             for r in recs:

@@ -261,6 +261,21 @@ class PartitionPlan:
         mean = sum(self.loads) / max(1, self.num_shards)
         return (max(self.loads) / mean) if mean > 0 else 1.0
 
+    def to_partition_specs(self, axis: str = "fold") -> list:
+        """The wire plan AS a mesh plan: translate this plan into
+        ``parallel.sharding``-style ``(pattern, PartitionSpec)`` rules,
+        one exact-match rule per tensor. Row-split tensors shard axis 0
+        over the ``axis`` mesh axis (the same rows the shard servers own
+        become the rows each device owns); pinned and balanced tensors
+        replicate. The result feeds ``parallel.sharding.param_path_specs``
+        / ``param_shardings`` unchanged — a sharded center and a
+        device-resident center are the same declaration."""
+        from jax.sharding import PartitionSpec as P  # lazy: plans must
+        # stay buildable (and hashable) on hosts without jax installed.
+        return [(f"^{re.escape(name)}$",
+                 P(axis) if len(segs) > 1 else P())
+                for name, segs in zip(self.names, self.segments)]
+
     # -- slicing -------------------------------------------------------
     def _shard_segs(self, shard: int) -> list:
         """``(tensor_index, start, stop)`` owned by ``shard``, in the ONE

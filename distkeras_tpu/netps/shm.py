@@ -61,7 +61,7 @@ from distkeras_tpu.runtime import config
 #: largest frame the connection has carried.
 _INITIAL_BYTES = 1 << 16
 
-TRANSPORTS = ("tcp", "shm")
+TRANSPORTS = ("tcp", "shm", "mesh")
 
 
 def transport_mode() -> str:

@@ -187,14 +187,17 @@ class Tuner:
             self.peer_codecs = tuple(
                 (client.peer_caps or {}).get("codecs", ()))
             self.stripe_ceiling = len(getattr(client, "_conns", ()) or (1,))
-        if client.active_transport == "shm":
+        if client.active_transport in ("shm", "mesh"):
             # The PR 6 rule, applied rather than re-measured: quantize
             # passes cost more than the bytes they save at memcpy speed,
-            # and a ring per stripe pays a doorbell per stripe.
-            self.propose("codec", client.codec, wire.CODEC_NONE,
-                         "shm_ring_rule", 0)
-            self.propose("shards", client.active_shards, 1,
-                         "shm_ring_rule", 0)
+            # and a ring per stripe pays a doorbell per stripe. The mesh
+            # dispatch is the limit case — zero wire bytes — so the same
+            # rule applies a fortiori (its own trigger name, so the
+            # decision log tells the dialects apart).
+            rule = ("mesh_rule" if client.active_transport == "mesh"
+                    else "shm_ring_rule")
+            self.propose("codec", client.codec, wire.CODEC_NONE, rule, 0)
+            self.propose("shards", client.active_shards, 1, rule, 0)
             return
         results = probe_codecs(client, template, probes=self.cfg.probes)
         winner = best_codec(results)
@@ -239,10 +242,10 @@ class Tuner:
         # comms with an f32 wire means bytes are the bottleneck — shrink
         # them (the probe usually already decided this at join).
         cur_codec = self.codec
-        if active_transport == "shm":
+        rule = "mesh_rule" if active_transport == "mesh" else "shm_ring_rule"
+        if active_transport in ("shm", "mesh"):
             if cur_codec not in (None, wire.CODEC_NONE):
-                self.propose("codec", cur_codec, wire.CODEC_NONE,
-                             "shm_ring_rule", r)
+                self.propose("codec", cur_codec, wire.CODEC_NONE, rule, r)
         elif (cur_codec == wire.CODEC_NONE and hidden is not None
                 and hidden < self.cfg.hidden_floor
                 and wire.CODEC_INT8 in self.peer_codecs):
@@ -251,9 +254,9 @@ class Tuner:
         # Striping: concurrent stripe RPCs only help where the wire is
         # the serial resource (TCP); on the ring one stripe wins.
         cur_shards = self.shards
-        if active_transport == "shm":
+        if active_transport in ("shm", "mesh"):
             if cur_shards is not None and cur_shards > 1:
-                self.propose("shards", cur_shards, 1, "shm_ring_rule", r)
+                self.propose("shards", cur_shards, 1, rule, r)
         elif (cur_shards in (None, 1) and hidden is not None
                 and hidden < self.cfg.hidden_floor
                 and min(self.cfg.max_shards, self.stripe_ceiling) > 1):

@@ -131,9 +131,18 @@ CODECS = (CODEC_NONE, CODEC_BF16, CODEC_INT8)
 #: the root-lineage counter (``root_u``) its warm standby seeds promotion
 #: from. A plain PSServer's ``True`` just says the build understands the
 #: tree dialect.
+#: ``mesh`` advertises the device-resident-center dialect
+#: (``netps/mesh.py``): a server whose center lives on device as donated
+#: jax buffers replaces the static bit with its live ``{"proc", "token",
+#: "devices", "backend"}`` advertisement in every join reply — the same
+#: replace-the-static-bit pattern as shm — and a client upgrades only
+#: when ``proc`` matches its own runtime identity (same boot, same
+#: process: a jax mesh cannot be dialed into from outside the process).
+#: Peers without the bit, or across a process boundary, negotiate down
+#: the usual ladder (shm ring, then TCP) untouched.
 CAPS = {"codecs": list(CODECS), "striping": True, "shm": True,
         "replication": True, "serving": True, "sharding": True,
-        "tuner": True, "tracing": True, "tree": True}
+        "tuner": True, "tracing": True, "tree": True, "mesh": True}
 
 #: the core parameter-server ops (``header["op"]``). Every op constant in
 #: the package MUST be declared in :data:`OP_REGISTRY` below — dk-check's
@@ -190,7 +199,7 @@ OP_REGISTRY = {
     OP_INFER: OpSpec("serving", ("arrays", "error")),
     OP_STATS: OpSpec(None, ("caps", "role", "snapshot", "ring", "updates",
                             "epoch", "members", "commits_total", "draining",
-                            "ready", "tree")),
+                            "ready", "tree", "fold_backend")),
     OP_PROBE: OpSpec("tuner", ("probe_bytes", "decode_s")),
 }
 
@@ -230,6 +239,7 @@ HEADER_KEYS = frozenset({
     "plan", "index", "count",
     # stats / health scrape
     "ring", "role", "snapshot", "members", "draining", "ready",
+    "fold_backend",
     # tuner probe
     "probe_bytes", "decode_s",
     # tracing + clock exchange

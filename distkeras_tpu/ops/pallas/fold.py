@@ -81,6 +81,36 @@ def _folder(codec: str, rows: int, wire_dtype: str, interpret: bool):
     )
 
 
+def fold_traced(center, q, s, *, codec: str, interpret: bool = False):
+    """Traceable twin of :func:`fold_compressed` for use INSIDE a jitted
+    collective body (the netps mesh dialect folds each device's center
+    shard through this under ``shard_map``): same kernel, same pad/
+    reshape discipline, but in jnp so the padding and the ``pallas_call``
+    trace into the surrounding program instead of staging through host
+    numpy. ``center`` is the local f32 shard, ``q`` the matching
+    wire-dtype shard, ``s`` a traced f32 scalar already folded to
+    ``commit_scale · tensor_scale``."""
+    n = int(np.prod(center.shape, dtype=np.int64)) if center.ndim else 1
+    if n == 0:
+        return center
+    rows = -(-n // _LANES)
+    rows += (-rows) % _ROW_ALIGN
+    if rows > _BLOCK_ROWS:
+        rows += (-rows) % _BLOCK_ROWS
+    total = rows * _LANES
+    cp = jnp.reshape(center.astype(jnp.float32), (-1,))
+    qp = jnp.reshape(q, (-1,))
+    if total != n:
+        cp = jnp.pad(cp, (0, total - n))
+        qp = jnp.pad(qp, (0, total - n))
+    wire_dtype = np.int8 if codec == "int8" else np.uint16
+    out = _folder(codec, rows, np.dtype(wire_dtype).str, interpret)(
+        jnp.reshape(s, (1, 1)).astype(jnp.float32),
+        jnp.reshape(cp, (rows, _LANES)),
+        jnp.reshape(qp, (rows, _LANES)))
+    return jnp.reshape(jnp.reshape(out, (-1,))[:n], center.shape)
+
+
 def fold_compressed(center, wire_arr, spec: dict, scale: float,
                     interpret: bool = False) -> np.ndarray:
     """``center + scale * dequant(wire_arr)`` with the dequant fused into

@@ -93,6 +93,34 @@ def mirror_tree_specs(opt_tree, params, like, default):
     return rec(opt_tree)
 
 
+def restrict_spec(spec: P, mesh: Mesh, shape=None) -> P:
+    """Degrade ``spec`` onto what ``mesh`` (and optionally ``shape``) can
+    carry: spec axes not present in the mesh become replicated, and — when
+    a concrete ``shape`` is given — so does any dimension the mesh axis
+    does not divide evenly (jax rejects ragged shards; replication is the
+    correct degradation because rules are declarative over shape families).
+    Shared by :func:`param_shardings` and the netps mesh dialect's
+    device-resident center (``netps.mesh.MeshFolder``)."""
+
+    def keep(d, axis):
+        if axis is None:
+            return None
+        if isinstance(axis, (tuple, list)):
+            kept = tuple(a for a in axis if a in mesh.axis_names)
+            axis = kept if kept else None
+        elif axis not in mesh.axis_names:
+            axis = None
+        if axis is None or shape is None:
+            return axis
+        names = axis if isinstance(axis, tuple) else (axis,)
+        size = int(np.prod([mesh.shape[a] for a in names], dtype=np.int64))
+        if d >= len(shape) or size < 1 or int(shape[d]) % size != 0:
+            return None
+        return axis
+
+    return P(*(keep(d, a) for d, a in enumerate(spec)))
+
+
 def param_shardings(params, mesh: Mesh, rules: Sequence[tuple[str, P]]):
     """Pytree of NamedShardings for ``params`` on ``mesh`` under ``rules``.
 
@@ -101,17 +129,5 @@ def param_shardings(params, mesh: Mesh, rules: Sequence[tuple[str, P]]):
     mesh shape.
     """
     specs = param_path_specs(params, rules)
-
-    def restrict(spec: P) -> P:
-        def keep(axis):
-            if axis is None:
-                return None
-            if isinstance(axis, (tuple, list)):
-                kept = tuple(a for a in axis if a in mesh.axis_names)
-                return kept if kept else None
-            return axis if axis in mesh.axis_names else None
-
-        return P(*(keep(a) for a in spec))
-
-    return jax.tree.map(lambda s: NamedSharding(mesh, restrict(s)),
+    return jax.tree.map(lambda s: NamedSharding(mesh, restrict_spec(s, mesh)),
                         specs, is_leaf=lambda x: isinstance(x, P))

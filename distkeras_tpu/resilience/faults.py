@@ -118,13 +118,18 @@ _KINDS = frozenset({
 #: flappy variant: down S, up S, down S again — two outages from one
 #: entry, exercising the drain->re-black-hole path. Schedule both in the
 #: tree NODE's process environment.
+#: ``mesh_down@R`` is the device-loss drill for the mesh transport
+#: dialect (``DKTPU_NET_TRANSPORT=mesh``): the in-process mesh dispatch
+#: raises ``ConnectionError`` when commit seq R crosses it, as a lost
+#: device mesh would — the client must demote to its negotiated shm/TCP
+#: dialect and retransmit the SAME seq, exactly-once riding through.
 _NET_KINDS = frozenset({
     "delay", "drop", "dup", "truncate", "partition", "evict",
     "delay_r", "drop_r", "dup_r", "truncate_r",
     "shm_delay", "shm_corrupt",
     "ps_crash", "ps_hang", "preempt",
     "serve_slow", "serve_drop",
-    "shard_crash", "link_down", "link_flap",
+    "shard_crash", "link_down", "link_flap", "mesh_down",
 })
 
 

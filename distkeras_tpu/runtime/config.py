@@ -251,11 +251,16 @@ ENV_REGISTRY: dict = _declare(
            "commit keeps ONE seq across all stripes (exactly-once).",
            "network"),
     EnvVar("DKTPU_NET_TRANSPORT", "str", "tcp",
-           "netps wire dialect: `tcp` (default), or `shm` — colocated "
+           "netps wire dialect: `tcp` (default), `shm` — colocated "
            "peers (boot-id match, negotiated in the join reply) move "
-           "payloads through a shared-memory ring with a UDS doorbell; "
-           "old peers and cross-host pairs silently stay on TCP with "
-           "every guarantee intact.",
+           "payloads through a shared-memory ring with a UDS doorbell — "
+           "or `mesh`: same-RUNTIME peers (boot-id + pid match) fold "
+           "straight into the server's device-resident center through an "
+           "in-process dispatch, zero wire bytes, with the shm ring "
+           "negotiated alongside as the demotion target (mesh -> shm -> "
+           "tcp). Old peers, cross-process, and cross-host pairs "
+           "silently stay on the lower dialects with every guarantee "
+           "intact.",
            "network"),
     EnvVar("DKTPU_NET_HIER", "bool", False,
            "Hierarchical two-level folds: each `run_remote` host "
@@ -273,7 +278,9 @@ ENV_REGISTRY: dict = _declare(
            "ring; `ps_crash`/`ps_hang` hit the server process; `preempt` "
            "drives the FleetScheduler's forced-preemption drill; "
            "`serve_slow`/`serve_drop` hit the serving frontend's request "
-           "stream; `link_down`/`link_flap` black-hole one aggregation-tree "
+           "stream; `mesh_down@R` severs the device-mesh dispatch at "
+           "commit seq R, forcing the mesh->shm/TCP demotion drill; "
+           "`link_down`/`link_flap` black-hole one aggregation-tree "
            "uplink, keyed by `TreeSpec.link_key(level, group)`) "
            "separated by `;`, e.g. `delay@3:0.2;drop@5;partition@7:2`. "
            "Empty = no injection. See docs/RESILIENCE.md.",

@@ -184,6 +184,12 @@ _METRICS = [
        "Routine post-join TCP-to-ring transport upgrades."),
     _m("netps.shm_fallbacks", "counter", "netps",
        "Mid-run ring-to-TCP downgrades after ring failures."),
+    _m("netps.mesh.upgrades", "counter", "netps",
+       "Post-join upgrades onto the same-runtime device-mesh dispatch."),
+    _m("netps.mesh.folds", "counter", "netps",
+       "Commits folded by the device-resident center's collective."),
+    _m("netps.mesh.demotions", "counter", "netps",
+       "Mesh-to-shm/TCP demotions (device loss, mesh_down, gone peer)."),
     _m("netps.endpoint_walks", "counter", "netps",
        "Endpoint-list failover steps taken by clients."),
     _m("netps.pull_torn_retries", "counter", "netps",

@@ -52,6 +52,16 @@ shard's standby must have promoted past epoch 0::
     NETPS_SMOKE_SHARDS=2 DKTPU_PS_STATE_DIR=/tmp/ps-state \\
         python tests/smoke_netps_chaos.py          # sharded failover path
 
+**Mesh-demotion mode** (``NETPS_SMOKE_MESH=1``): the PS runs IN THIS
+process (the mesh dialect is a same-runtime contract — a subprocess
+cannot share the jax device mesh), workers negotiate the device-resident
+center, and ``mesh_down@R`` severs the dispatch mid-run. The struck
+worker demotes to its negotiated shm ring and retransmits the same seq;
+exactly-once and zero lost windows are asserted on the on-disk journal::
+
+    NETPS_SMOKE_MESH=1 DKTPU_NET_FAULTS="mesh_down@6;seed=3" \\
+        python tests/smoke_netps_chaos.py          # mesh demotion path
+
 **Region-partition tree mode** (``NETPS_SMOKE_TREE=1`` + state dir): a
 2-region, 3-level aggregation tree (workers -> region ``TreeNode``
 subprocesses -> root subprocess). Region 0's aggregator SIGKILLs itself
@@ -246,6 +256,78 @@ def _assert_trace_evidence(state_dir, standby_mode) -> None:
     print(f"netps trace evidence: traces={rep['traces']} "
           f"commits={rep['commits']} accepted={len(accepted)} orphans=0 "
           f"flight_folds={len(folds)} processes={len(rep['processes'])}")
+
+
+def _run_mesh(df, model) -> int:
+    """Mesh-demotion mode (``NETPS_SMOKE_MESH=1``): the PS and the
+    workers share THIS process's jax runtime, the data plane negotiates
+    the mesh dialect (device-resident center, zero wire bytes), and
+    ``mesh_down@R`` kills the device dispatch mid-run — the struck
+    worker must demote to its negotiated shm ring (ONE strike, no
+    rejoin) and retransmit the SAME seq, with exactly-once and zero
+    lost windows proven on the on-disk journal."""
+    import tempfile
+
+    faults_spec = os.environ.get("DKTPU_NET_FAULTS", "")
+    assert "mesh_down" in faults_spec, (
+        "mesh mode expects a mesh_down@R entry in DKTPU_NET_FAULTS")
+    # The workers request the dialect; the server resolves it live.
+    os.environ["DKTPU_NET_TRANSPORT"] = "mesh"
+    state_dir = (os.environ.get("DKTPU_PS_STATE_DIR")
+                 or tempfile.mkdtemp(prefix="dktpu-mesh-smoke-"))
+    server = PSServer(discipline="adag", lease_s=5.0, transport="mesh",
+                      state_dir=state_dir, snapshot_every=10).start()
+    try:
+        trainer = ADAG(model, loss="sparse_categorical_crossentropy",
+                       num_workers=4, batch_size=16, num_epoch=3,
+                       learning_rate=0.1, communication_window=4,
+                       seed=0, remote=server.endpoint)
+        trained = trainer.train(df, shuffle=True)
+        assert server._mesh_folder is not None, (
+            "the PS never resolved the mesh fold path")
+        total = server.commits_total
+        commit_log = list(server.commit_log)
+        log_dropped = server._log_dropped
+    finally:
+        server.close()
+    acc = float((np.asarray(trained.predict(jnp.asarray(
+        df["features"]))).argmax(-1) == df["label"]).mean())
+    reg = telemetry.get()
+    upgrades = reg.counter("netps.mesh.upgrades").value
+    folds = reg.counter("netps.mesh.folds").value
+    demotions = reg.counter("netps.mesh.demotions").value
+    # Exactly-once on the on-disk journal: no (wid, seq) folded twice,
+    # epochs nondecreasing. Snapshot compaction bounds the journal to the
+    # tail since the last snapshot, so contiguity is asserted within it.
+    records, _ = _assert_journal_invariants(state_dir, "mesh")
+    assert records, "mesh: the journal tail is empty"
+    tail: dict = {}
+    for r in records:
+        tail.setdefault(int(r["wid"]), []).append(int(r["seq"]))
+    for wid, seqs in sorted(tail.items()):
+        assert seqs == list(range(seqs[0], seqs[0] + len(seqs))), (
+            f"mesh: journal tail lost a window for worker {wid}: {seqs}")
+    # Zero lost windows over the WHOLE run (the in-process commit log is
+    # the full history): every worker's seqs are contiguous from 0 — the
+    # demoted seq's retransmit landed exactly once, and no later window
+    # vanished in the dialect switch.
+    assert len(commit_log) + log_dropped == total
+    per_worker: dict = {}
+    for wid, seq, _st in commit_log:
+        assert seq not in per_worker.setdefault(int(wid), set()), (
+            f"mesh: commit ({wid}, {seq}) folded twice")
+        per_worker[int(wid)].add(int(seq))
+    for wid, seqs in sorted(per_worker.items()):
+        assert seqs == set(range(max(seqs) + 1)), (
+            f"mesh: worker {wid} lost a window: {sorted(seqs)}")
+    print(f"netps mesh demotion: acc={acc:.4f} folds={total} "
+          f"workers={len(per_worker)} mesh_upgrades={upgrades:.0f} "
+          f"mesh_folds={folds:.0f} mesh_demotions={demotions:.0f}")
+    assert acc > 0.85, f"accuracy collapsed across the demotion: {acc}"
+    assert upgrades >= 1, "no worker ever negotiated the mesh dialect"
+    assert folds >= 1, "the device collective never folded a commit"
+    assert demotions >= 1, "mesh_down never bit — the drill is dead"
+    return 0
 
 
 def _run_failover(df, model) -> int:
@@ -722,6 +804,8 @@ def main() -> int:
                     "label": y.astype(np.int32)})
     model = Model.build(MLP(hidden=(16,), num_outputs=3),
                         jnp.zeros((1, 4), jnp.float32), seed=0)
+    if os.environ.get("NETPS_SMOKE_MESH"):
+        return _run_mesh(df, model)
     if os.environ.get("NETPS_SMOKE_TREE"):
         return _run_tree(df, model)
     if int(os.environ.get("NETPS_SMOKE_SHARDS") or 0) > 1:

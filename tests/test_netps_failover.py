@@ -243,6 +243,118 @@ def test_read_journal_exposes_fold_order_evidence(tmp_path):
     assert len(seen) == len(records), "a commit was journaled twice"
 
 
+def test_mesh_server_restart_recovers_bit_identically_and_dedups(tmp_path):
+    """The device-resident center is as durable as the host one: every
+    mesh fold journals its ``(wid, seq, staleness, epoch)`` tail before
+    the ack, so a killed mesh server relaunched on its state dir — with
+    or WITHOUT a device mesh — replays to exactly the pre-crash device
+    center, and a pre-crash commit's retransmit answers duplicate=True
+    over the mesh dialect itself."""
+    d = str(tmp_path / "state")
+    srv = PSServer(discipline="adag", transport="mesh", state_dir=d,
+                   snapshot_every=4).start()
+    try:
+        drive_commits(srv.endpoint, 10, transport="mesh")
+        pre = srv.center()
+        pre_updates, pre_total = srv.updates, srv.commits_total
+        pre_seq = dict(srv._last_seq)
+    finally:
+        srv.close()
+    # Recovery does not need the device mesh: a plain numpy replay lands
+    # on the same bytes the device folds produced (the exact-mode pin).
+    srv2 = PSServer(discipline="adag", state_dir=d)
+    try:
+        for a, b in zip(pre, srv2.center()):
+            assert a.tobytes() == b.tobytes(), \
+                "mesh-fold recovery is not bit-identical"
+        assert srv2.updates == pre_updates
+        assert srv2.commits_total == pre_total
+        assert srv2._last_seq == pre_seq
+    finally:
+        srv2.close()
+    # A mesh relaunch adopts the recovered center onto the device, the
+    # recovered dedup table answers the resumed worker's retransmit, and
+    # new folds keep going through the collective.
+    srv3 = PSServer(discipline="adag", transport="mesh",
+                    state_dir=d).start()
+    try:
+        c = PSClient(srv3.endpoint, worker_id=0, transport="mesh", **FAST)
+        try:
+            _, upd = c.join()
+            assert c.active_transport == "mesh"
+            assert c._seq == 9  # resumed past the recovered fold history
+            before = srv3.center()
+            c._seq = 5  # retransmit of an ACKed pre-crash commit
+            res = c.commit([np.ones_like(a) for a in before], upd)
+            assert res.duplicate and not res.applied
+            for a, b in zip(before, srv3.center()):
+                assert a.tobytes() == b.tobytes(), \
+                    "a duplicate reached the device fold"
+            c._seq = 9  # back to the resumed head: a FRESH commit folds
+            res = c.commit([np.ones_like(a) for a in before], upd)
+            assert res.applied and not res.duplicate
+        finally:
+            c.close()
+        assert srv3.commits_total == pre_total + 1
+    finally:
+        srv3.close()
+
+
+def test_sigkill_mid_mesh_run_restart_recovers_bit_identically(tmp_path):
+    """The real thing: a mesh PS subprocess is SIGKILLed with folds
+    behind it — no drain, no snapshot finalize — and a relaunch on its
+    state dir replays the journal tail to the same center a never-killed
+    reference server reaches from the identical commit sequence."""
+    d = str(tmp_path / "state")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               DKTPU_NET_TRANSPORT="mesh")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distkeras_tpu.netps", "--host", "127.0.0.1",
+         "--port", "0", "--state-dir", d, "--snapshot-every", "4"],
+        stdout=subprocess.PIPE, env=env, text=True)
+    try:
+        ready = proc.stdout.readline()
+        assert ready.startswith("NETPS_READY "), ready
+        endpoint = ready.split()[1]
+        # Cross-process: the client negotiates TCP (the mesh advert's
+        # proc does not match), but the SERVER still folds on device.
+        drive_commits(endpoint, 7)
+        probe = PSClient(endpoint, worker_id=1, **FAST)
+        try:
+            assert probe.stats()["fold_backend"] == "mesh", \
+                "subprocess PS did not resolve the mesh fold path"
+        finally:
+            probe.close()
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    ref_srv = PSServer(discipline="adag").start()
+    try:
+        drive_commits(ref_srv.endpoint, 7)
+        ref = ref_srv.center()
+    finally:
+        ref_srv.close()
+    srv2 = PSServer(discipline="adag", state_dir=d).start()
+    try:
+        assert srv2.commits_total == 7
+        for a, b in zip(ref, srv2.center()):
+            assert a.tobytes() == b.tobytes(), \
+                "SIGKILL recovery diverged from the no-kill reference"
+        c = PSClient(srv2.endpoint, worker_id=0, **FAST)
+        try:
+            _, upd = c.join()
+            c._seq = 3  # retransmit of a pre-kill ACKed commit
+            res = c.commit([np.ones_like(a) for a in ref], upd)
+            assert res.duplicate and not res.applied
+        finally:
+            c.close()
+    finally:
+        srv2.close()
+
+
 # ---------------------------------------------------------------------------
 # Epoch fencing
 # ---------------------------------------------------------------------------

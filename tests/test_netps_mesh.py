@@ -1,0 +1,323 @@
+"""The mesh transport dialect: a same-runtime client folds its deltas
+straight into a device-resident center — zero wire bytes, the Pallas
+compressed-domain fold running inside a ``shard_map`` collective — while
+every PR 7/8 guarantee (dedup, epoch fencing, durable journal, bounded
+staleness) rides through the host-side journal tail unchanged.
+
+The contract pinned here:
+
+* **Negotiation is live, not static** — the ``mesh`` caps bit is only
+  honoured when the server's advertised ``proc`` matches this process's
+  ``local_mesh_id()``; a TCP client against the same server never sees
+  the dialect, and a mesh client negotiates the shm ring TOO (it is the
+  demotion target).
+* **Bit-identical parity** — on CPU the exact two-program fold makes a
+  mesh server's center equal a plain server's byte for byte, for every
+  codec (none/bf16/int8): the device-resident center is an optimisation,
+  never a numerics fork.
+* **Demotion is one strike and exactly-once** — an injected
+  ``mesh_down`` mid-run sweeps the dialect, the SAME seq retransmits on
+  the negotiated shm/TCP path, and the run's final center still matches
+  the no-fault reference bit for bit with ``commits_total == n``.
+* **One plan, two fabrics** — ``PartitionPlan.to_partition_specs``
+  translates the wire-shard plan into mesh ``PartitionSpec`` rules, so
+  the rows a shard server owns are the rows a device owns.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from distkeras_tpu import telemetry
+from distkeras_tpu.netps import PSClient, PSServer, wire
+from distkeras_tpu.netps import mesh as _mesh
+from distkeras_tpu.netps.client import (
+    _BAD_KNOB_COMBOS_WARNED,
+    _validate_knob_combo,
+)
+from distkeras_tpu.netps.shards.plan import SPLIT, PartitionPlan
+from distkeras_tpu.resilience import faults
+
+FAST = dict(timeout=1.0, retries=3, backoff=0.01)
+
+
+def leaves():
+    rng = np.random.default_rng(7)
+    return [rng.normal(size=(4, 3)).astype(np.float32),
+            rng.normal(size=(8,)).astype(np.float32)]
+
+
+def drive_commits(endpoint, n, *, compress="none", worker_id=0, **kw):
+    """Join + fold ``n`` deterministic commits; returns the final client
+    (still open — callers close it) and its view of (center, updates)."""
+    rng = np.random.default_rng(worker_id + 1)
+    c = PSClient(endpoint, worker_id=worker_id, compress=compress,
+                 **dict(FAST, **kw))
+    center, upd = c.join(init=leaves())
+    for _ in range(n):
+        delta = [rng.normal(scale=0.1, size=a.shape).astype(np.float32)
+                 for a in center]
+        c.commit(delta, upd)
+        center, upd = c.pull()
+    return c, center, upd
+
+
+# ---------------------------------------------------------------------------
+# Negotiation + observability
+# ---------------------------------------------------------------------------
+
+def test_mesh_negotiation_upgrades_and_stats_expose_backend():
+    """A same-process mesh client upgrades (and negotiates shm as its
+    demotion target); the server's stats answer names the resolved fold
+    backend ``mesh``. A plain TCP client against the SAME server never
+    sees the dialect — old peers are unaffected by construction."""
+    telemetry.reset()
+    srv = PSServer(discipline="adag", transport="mesh").start()
+    try:
+        c, center, _ = drive_commits(srv.endpoint, 3, transport="mesh")
+        try:
+            assert c.active_transport == "mesh"
+            assert c.mesh_info is not None
+            assert c.mesh_info["proc"] == _mesh.local_mesh_id()
+            assert c.shm_info is not None, \
+                "a mesh client must negotiate its shm demotion target"
+            assert c.stats()["fold_backend"] == "mesh"
+        finally:
+            c.close()
+        # The device-resident center and the client's pulled view agree.
+        for a, b in zip(srv.center(), center):
+            assert a.tobytes() == b.tobytes()
+        reg = telemetry.get()
+        assert reg.counter("netps.mesh.upgrades").value == 1
+        assert reg.counter("netps.mesh.folds").value >= 3
+        # TCP client: no mesh advert honoured, plain dialect, still folds.
+        t = PSClient(srv.endpoint, worker_id=1, transport="tcp", **FAST)
+        try:
+            _, upd = t.join()
+            assert t.active_transport == "tcp"
+            assert t.mesh_info is None
+            res = t.commit([np.ones_like(a) for a in srv.center()], upd)
+            assert res.applied
+        finally:
+            t.close()
+    finally:
+        srv.close()
+
+
+def test_mesh_advert_refused_across_process_boundary(monkeypatch):
+    """A forged/stale mesh advert whose ``proc`` is not THIS runtime is
+    ignored: the client stays on its negotiated socket dialect rather
+    than dispatching into a mesh that does not exist here."""
+    import types
+
+    from distkeras_tpu.netps import client as client_mod
+    srv = PSServer(discipline="adag", transport="mesh").start()
+    try:
+        # Patch only the CLIENT's view of the runtime identity — the
+        # server (same process here) keeps advertising its real one, so
+        # the advert now looks like it came from another process.
+        monkeypatch.setattr(
+            client_mod, "_mesh",
+            types.SimpleNamespace(local_mesh_id=lambda: "other:0",
+                                  dispatch=_mesh.dispatch))
+        c = PSClient(srv.endpoint, worker_id=0, transport="mesh", **FAST)
+        try:
+            c.join(init=leaves())
+            assert c.mesh_info is None
+            assert c.active_transport in ("shm", "tcp")
+        finally:
+            c.close()
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Parity: the device-resident center is not a numerics fork
+# ---------------------------------------------------------------------------
+
+@pytest.mark.filterwarnings("ignore:measured-bad knob combination")
+@pytest.mark.parametrize("compress", ["none", "bf16", "int8"])
+def test_mesh_parity_bit_identical_across_codecs(compress):
+    """THE parity pin: the same deterministic commit sequence against a
+    mesh server and a plain server ends in byte-for-byte equal centers —
+    for f32 and both compressed-domain codecs. On CPU the folder's exact
+    two-program formulation rounds between multiply and add exactly as
+    numpy does."""
+    assert compress in wire.CODECS
+    ref_srv = PSServer(discipline="adag", transport="tcp").start()
+    mesh_srv = PSServer(discipline="adag", transport="mesh").start()
+    try:
+        rc, ref_center, _ = drive_commits(
+            ref_srv.endpoint, 8, compress=compress, transport="tcp")
+        rc.close()
+        mc, mesh_center, _ = drive_commits(
+            mesh_srv.endpoint, 8, compress=compress, transport="mesh")
+        try:
+            assert mc.active_transport == "mesh"
+        finally:
+            mc.close()
+        assert mesh_srv.commits_total == ref_srv.commits_total == 8
+        for i, (a, b) in enumerate(zip(ref_srv.center(),
+                                       mesh_srv.center())):
+            assert a.tobytes() == b.tobytes(), \
+                f"tensor {i} diverged under codec {compress!r}"
+        for a, b in zip(ref_center, mesh_center):
+            assert a.tobytes() == b.tobytes()
+    finally:
+        mesh_srv.close()
+        ref_srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Demotion: one strike, exactly-once, no numerics fork
+# ---------------------------------------------------------------------------
+
+def test_mesh_down_demotes_midrun_exactly_once():
+    """The device-loss drill: ``mesh_down`` fires mid-run, the dispatch
+    raises as a lost device mesh would, the client sweeps the dialect
+    (ONE strike) and retransmits the SAME seq on its negotiated shm ring.
+    Exactly-once: every commit folds once, and the final center matches
+    the no-fault reference bit for bit."""
+    n = 8
+    ref_srv = PSServer(discipline="adag", transport="tcp").start()
+    try:
+        rc, _, _ = drive_commits(ref_srv.endpoint, n, transport="tcp")
+        rc.close()
+        ref = ref_srv.center()
+        ref_total = ref_srv.commits_total
+    finally:
+        ref_srv.close()
+
+    telemetry.reset()
+    # Client _seq starts at -1: the 5th commit carries seq 4.
+    faults.set_net_plan(faults.FaultPlan.parse_net("mesh_down@4"))
+    srv = PSServer(discipline="adag", transport="mesh").start()
+    try:
+        c, center, _ = drive_commits(srv.endpoint, n, transport="mesh")
+        try:
+            assert c.mesh_info is None, "mesh_down must sweep the dialect"
+            assert c.active_transport == "shm", \
+                "demotion lands on the negotiated shm ring, not a rejoin"
+        finally:
+            c.close()
+        assert srv.commits_total == ref_total == n, \
+            "the retransmitted seq must fold exactly once"
+        for i, (a, b) in enumerate(zip(ref, srv.center())):
+            assert a.tobytes() == b.tobytes(), \
+                f"tensor {i} diverged across the demotion"
+        for a, b in zip(ref, center):
+            assert a.tobytes() == b.tobytes()
+        reg = telemetry.get()
+        assert reg.counter("netps.mesh.demotions").value == 1
+        whys = [e["why"] for e in reg.events()
+                if e["kind"] == "netps_mesh_demotion"]
+        assert len(whys) == 1 and "ConnectionError" in whys[0]
+    finally:
+        srv.close()
+        faults.set_net_plan(None)
+
+
+# ---------------------------------------------------------------------------
+# One plan, two fabrics
+# ---------------------------------------------------------------------------
+
+def test_to_partition_specs_mirrors_row_splits():
+    """Row-split tensors shard axis 0 over the mesh axis; pinned and
+    balanced tensors replicate. The rule patterns are exact-match
+    anchored, so ``param_1`` never swallows ``param_10``."""
+    from jax.sharding import PartitionSpec as P
+    plan = PartitionPlan.build(
+        ["emb", "bias"], [(16, 4), (8,)], 2, rules=[("^emb$", SPLIT)])
+    specs = dict(plan.to_partition_specs("fold"))
+    assert specs["^emb$"] == P("fold")
+    assert specs["^bias$"] == P()
+    # Default axis name matches the mesh dialect's.
+    assert dict(plan.to_partition_specs())["^emb$"] == P(_mesh.MESH_AXIS)
+
+
+def test_mesh_folder_honours_plan_specs():
+    """A MeshFolder built with a plan shards exactly the tensors the plan
+    row-splits — the wire plan IS the mesh plan — and still folds
+    bit-identically to numpy in exact mode."""
+    import jax
+    rng = np.random.default_rng(3)
+    rows = max(2 * len(jax.devices()), 8)
+    center = [rng.normal(size=(rows, 3)).astype(np.float32),
+              rng.normal(size=(5,)).astype(np.float32)]
+    plan = PartitionPlan.build(
+        ["big", "small"], [(rows, 3), (5,)], 2, rules=[("^big$", SPLIT)])
+    folder = _mesh.MeshFolder([a.copy() for a in center], plan=plan)
+    try:
+        delta = [rng.normal(scale=0.1, size=a.shape).astype(np.float32)
+                 for a in center]
+        folder.fold(delta, 0.5)
+        want = [c + np.float32(0.5) * d for c, d in zip(center, delta)]
+        for a, b in zip(folder.center_host(), want):
+            assert a.tobytes() == b.tobytes()
+    finally:
+        folder.close()
+
+
+# ---------------------------------------------------------------------------
+# Fold-parity gate: the fused collective vs the numpy oracle
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("codec", ["none", "bf16", "int8"])
+def test_mesh_fused_interpret_fold_matches_numpy_oracle(codec):
+    """The fold-parity job's mesh arm: the FUSED formulation — the Pallas
+    dequant-fused kernel inside the shard_map collective body, interpret
+    mode on this CPU (compiled on TPU) — against the pure-numpy
+    reference, at the kernel parity suite's own allclose bar."""
+    from distkeras_tpu.netps.fold import fold_compressed_numpy
+
+    rng = np.random.default_rng(11)
+    center = [rng.normal(size=(16, 4)).astype(np.float32),
+              rng.normal(size=(8,)).astype(np.float32)]
+    folder = _mesh.MeshFolder([a.copy() for a in center], interpret=True)
+    try:
+        ref = [a.copy() for a in center]
+        scale = 0.25
+        for _ in range(3):
+            raw = [rng.normal(scale=0.2, size=a.shape).astype(np.float32)
+                   for a in center]
+            entries = []
+            for a, r in zip(raw, ref):
+                if codec == "none":
+                    entries.append(a)
+                    r += np.float32(scale) * a
+                else:
+                    q, spec = wire.codec_encode(a, codec)
+                    entries.append((q, spec))
+                    fold_compressed_numpy(r, q, spec, scale)
+            folder.fold(entries, scale)
+        for i, (got, want) in enumerate(zip(folder.center_host(), ref)):
+            np.testing.assert_allclose(
+                got, want, rtol=1e-6, atol=1e-7,
+                err_msg=f"tensor {i} diverged under codec {codec!r}")
+    finally:
+        folder.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: knob-combo validation covers the mesh dialect
+# ---------------------------------------------------------------------------
+
+def test_mesh_knob_combos_warn_once_per_process():
+    _BAD_KNOB_COMBOS_WARNED.clear()
+    telemetry.reset()
+    with pytest.warns(RuntimeWarning, match="int8\\+mesh"):
+        _validate_knob_combo("int8", "mesh", 1)
+    with pytest.warns(RuntimeWarning, match="shards>1\\+mesh"):
+        _validate_knob_combo("none", "mesh", 2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        _validate_knob_combo("int8", "mesh", 4)  # dedup: silent
+        _validate_knob_combo("none", "mesh", 1)  # good pairing: silent
+        _validate_knob_combo("bf16", "mesh", 1)  # bf16+mesh is measured-OK
+    reg = telemetry.get()
+    assert reg.counter("tuner.knob_warnings").value == 2
+    combos = [e["combo"] for e in reg.events()
+              if e["kind"] == "netps_knob_warning"]
+    assert combos == ["int8+mesh", "shards>1+mesh"]
+    _BAD_KNOB_COMBOS_WARNED.clear()
